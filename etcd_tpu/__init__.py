@@ -1,0 +1,29 @@
+"""etcd-tpu: a TPU-native rebuild of etcd (reference: etcd v0.5.0-alpha).
+
+A highly-available, strongly-consistent key-value store for shared
+configuration and service discovery, re-architected so that the
+storage/consensus *data plane* -- WAL record decode + rolling CRC32
+verification, snapshot hashing, Raft log append/term-match, and quorum
+commit-index computation -- executes as batched JAX/Pallas computations
+over tens of thousands of co-hosted Raft groups sharded across a TPU
+slice.
+
+Layer map (mirrors reference SURVEY.md section 1, bottom-up):
+
+    utils/      L1  flags, types, transport, cors, errors, wait
+    wire/       L2  gogoproto-compatible wire formats + array codecs
+    crc/        L1* CRC32-Castagnoli: host, GF(2) combine, affine fixup
+    wal/        L3* write-ahead log; batched device replay
+    snap/       L3* snapshotter with device-hashed blobs
+    raft/       L4* pure functional raft core; host driver; batched engine
+    parallel/   L4  mesh sharding + ICI collectives for group state
+    store/      L4  hierarchical KV tree, watchers, TTLs (host)
+    server/     L5  EtcdServer orchestration, membership, discovery
+    api/        L6  /v2/keys REST + /raft peer endpoint + proxy
+    cli.py      L7  etcd-compatible flags/env entry point
+    ops/        device kernels (MXU CRC-as-matmul, quorum commit)
+
+Starred layers have a TPU device path in addition to the host path.
+"""
+
+__version__ = "0.5.0-alpha+tpu"

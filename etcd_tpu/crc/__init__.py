@@ -1,0 +1,25 @@
+"""L1* CRC32-Castagnoli: host digest, GF(2) algebra, parallel combine.
+
+The reference forks Go's stdlib crc32 digest so it can *seed from a
+previous CRC* (pkg/crc/crc.go:23), enabling the WAL's rolling checksum
+chained across records and file cuts.  ``Digest`` reproduces that seam.
+
+The TPU-native addition is the GF(2) view (``gf2``): CRC32 is a linear
+code, so block CRCs combine with 32x32 bit-matrix algebra.  That turns
+the reference's strictly-sequential rolling checksum into
+embarrassingly-parallel per-record CRCs plus a batched affine fix-up --
+the foundation of the device replay path (ops/crc_kernel.py).
+"""
+
+from .crc32c import Digest, update, value, raw_update, make_table, new_digest
+from . import gf2
+
+__all__ = [
+    "Digest",
+    "update",
+    "value",
+    "raw_update",
+    "make_table",
+    "new_digest",
+    "gf2",
+]

@@ -1,0 +1,21 @@
+"""WAL error vocabulary (reference wal/wal.go:44-49)."""
+
+
+class WALError(Exception):
+    pass
+
+
+class MetadataConflictError(WALError):
+    """Conflicting metadata found (ErrMetadataConflict)."""
+
+
+class FileNotFoundError_(WALError):
+    """No WAL file found for the requested index (ErrFileNotFound)."""
+
+
+class IndexNotFoundError(WALError):
+    """Requested index not present in the WAL (ErrIndexNotFound)."""
+
+
+class CRCMismatchError(WALError):
+    """Rolling checksum mismatch (ErrCRCMismatch)."""

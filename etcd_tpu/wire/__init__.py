@@ -1,0 +1,59 @@
+"""L2 wire formats: gogoproto-compatible protobuf codecs.
+
+Byte-compatible with the reference's generated marshalers
+(raft/raftpb/raft.pb.go, wal/walpb/record.pb.go, snap/snappb/snap.pb.go)
+so that WAL segments and snapshot files written by either implementation
+replay in the other.
+"""
+
+from .proto import (
+    Entry,
+    Snapshot,
+    Message,
+    HardState,
+    ConfChange,
+    Record,
+    SnapPb,
+    ENTRY_NORMAL,
+    ENTRY_CONF_CHANGE,
+    CONF_CHANGE_ADD_NODE,
+    CONF_CHANGE_REMOVE_NODE,
+    MSG_HUP,
+    MSG_BEAT,
+    MSG_PROP,
+    MSG_APP,
+    MSG_APP_RESP,
+    MSG_VOTE,
+    MSG_VOTE_RESP,
+    MSG_SNAP,
+    MSG_DENIED,
+    EMPTY_HARD_STATE,
+    is_empty_hard_state,
+    is_empty_snap,
+)
+
+__all__ = [
+    "Entry",
+    "Snapshot",
+    "Message",
+    "HardState",
+    "ConfChange",
+    "Record",
+    "SnapPb",
+    "ENTRY_NORMAL",
+    "ENTRY_CONF_CHANGE",
+    "CONF_CHANGE_ADD_NODE",
+    "CONF_CHANGE_REMOVE_NODE",
+    "MSG_HUP",
+    "MSG_BEAT",
+    "MSG_PROP",
+    "MSG_APP",
+    "MSG_APP_RESP",
+    "MSG_VOTE",
+    "MSG_VOTE_RESP",
+    "MSG_SNAP",
+    "MSG_DENIED",
+    "EMPTY_HARD_STATE",
+    "is_empty_hard_state",
+    "is_empty_snap",
+]

@@ -1,0 +1,16 @@
+"""Test harness configuration.
+
+Device-path tests run on a virtual 8-device CPU mesh so sharding
+semantics are exercised without TPU hardware (the driver separately
+dry-runs the multichip path; bench.py runs on the real chip).  The env
+vars must be set before jax is first imported anywhere in the process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

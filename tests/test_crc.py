@@ -1,0 +1,133 @@
+"""CRC32C + GF(2) combine tests.
+
+``google_crc32c`` (an independent, hardware-accelerated implementation
+of the same standard CRC32C as Go's crc32.Castagnoli) acts as the
+oracle for the seedable-digest semantics of the reference's pkg/crc.
+"""
+
+import numpy as np
+import pytest
+
+import google_crc32c
+
+from etcd_tpu.crc import Digest, gf2, raw_update, update, value
+from etcd_tpu.crc.crc32c import _update_py
+
+
+RNG = np.random.default_rng(42)
+
+
+def rand_bytes(n):
+    return RNG.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+def test_value_matches_oracle():
+    for n in (0, 1, 7, 64, 1000):
+        data = rand_bytes(n)
+        assert value(data) == google_crc32c.value(data)
+
+
+def test_pure_python_matches_oracle():
+    for n in (0, 1, 3, 255, 513):
+        data = rand_bytes(n)
+        assert _update_py(0, data) == google_crc32c.value(data)
+        seed = int(RNG.integers(0, 1 << 32))
+        assert _update_py(seed, data) == google_crc32c.extend(seed, data)
+
+
+def test_digest_seeding_chains_like_reference():
+    # pkg/crc/crc.go:23 — New(prev) continues a rolling checksum: the
+    # WAL encoder writes rec.Crc = digest-after-this-record
+    # (wal/encoder.go:25-27).
+    a, b, c = rand_bytes(100), rand_bytes(50), rand_bytes(7)
+    d = Digest(0)
+    d.write(a)
+    crc_a = d.sum32()
+    d.write(b)
+    crc_ab = d.sum32()
+    # restart from the stored value, as Cut does (wal/wal.go:232-233)
+    d2 = Digest(crc_ab)
+    d2.write(c)
+    whole = Digest(0)
+    whole.write(a + b + c)
+    assert d2.sum32() == whole.sum32()
+    assert crc_a == value(a)
+
+
+def test_incremental_equals_oneshot():
+    a, b = rand_bytes(33), rand_bytes(77)
+    assert update(update(0, a), b) == value(a + b)
+
+
+def test_raw_update_linearity():
+    # raw_update(s, m) = raw_update(s, zeros) ^ raw_update(0, m)
+    m = rand_bytes(40)
+    s = 0x12345678
+    lhs = raw_update(s, m)
+    rhs = raw_update(s, b"\x00" * 40) ^ raw_update(0, m)
+    assert lhs == rhs
+
+
+def test_leading_zeros_invariant_raw():
+    # front-zero-padding does not change a zero-seeded raw CRC — the
+    # property that lets the device kernel pad records at the front.
+    m = rand_bytes(100)
+    assert raw_update(0, m) == raw_update(0, b"\x00" * 64 + m)
+
+
+def test_zero_operator_matches_raw():
+    for n in (0, 1, 5, 64, 1000):
+        s = int(RNG.integers(0, 1 << 32))
+        assert gf2.shift(s, n) == raw_update(s, b"\x00" * n)
+
+
+def test_combine_matches_concat():
+    for la, lb in ((0, 10), (10, 0), (13, 29), (256, 1000)):
+        a, b = rand_bytes(la), rand_bytes(lb)
+        assert gf2.combine(value(a), value(b), lb) == value(a + b)
+
+
+def test_combine_batch_and_chain_verify():
+    n = 200
+    lens = RNG.integers(1, 400, size=n)
+    blobs = [rand_bytes(int(l)) for l in lens]
+    # simulate the WAL rolling chain
+    stored = np.empty(n, dtype=np.uint32)
+    d = Digest(0)
+    for i, blob in enumerate(blobs):
+        d.write(blob)
+        stored[i] = d.sum32()
+    crcs = np.array([value(b) for b in blobs], dtype=np.uint32)
+    ok = gf2.chain_verify(0, stored, crcs, lens)
+    assert ok.all()
+    # corrupt one record's stored crc -> exactly the two dependent
+    # checks fail (record i, and record i+1 whose seed changed)
+    bad = stored.copy()
+    bad[50] ^= 0x1
+    ok = gf2.chain_verify(0, bad, crcs, lens)
+    assert not ok[50] and not ok[51]
+    assert ok[:50].all() and ok[52:].all()
+
+
+def test_chain_verify_nonzero_seed():
+    # segment boundary: decoder restarts from the crcType record value
+    # (wal/wal.go:184-192)
+    seed = 0xCAFEBABE
+    blobs = [rand_bytes(10), rand_bytes(20)]
+    stored = []
+    d = Digest(seed)
+    for b in blobs:
+        d.write(b)
+        stored.append(d.sum32())
+    crcs = np.array([value(b) for b in blobs], dtype=np.uint32)
+    ok = gf2.chain_verify(seed, np.array(stored, dtype=np.uint32), crcs,
+                          np.array([10, 20]))
+    assert ok.all()
+
+
+def test_matmul_identity_and_bits():
+    ident = gf2.identity()
+    assert (gf2.matmul(ident, ident) == ident).all()
+    x = np.uint32(0xA5A5A5A5)
+    assert gf2.from_bits(gf2.to_bits(x)) == x
+    assert gf2.matvec(ident, int(x)) == int(x)
