@@ -1,0 +1,16 @@
+"""L3* snapshotter: CRC-protected raft snapshot files.
+
+Reference snap/snapshotter.go.  The whole-file CRC is the device-hash
+target for large store snapshots (bench config 3); ``Snapshotter``
+accepts a pluggable ``crc_fn`` so the device kernel slots in behind the
+same seam.
+"""
+
+from .snapshotter import SnapEmptyError, Snapshotter, SnapCRCMismatchError, NoSnapshotError
+
+__all__ = [
+    "Snapshotter",
+    "NoSnapshotError",
+    "SnapCRCMismatchError",
+    "SnapEmptyError",
+]

@@ -1,0 +1,131 @@
+"""Snapshot persistence (reference snap/snapshotter.go:29-150).
+
+Files are named ``%016x-%016x.snap`` (term, index) and contain a
+snappb wrapper {crc, data} where crc is the whole-blob CRC32C and data
+the marshaled raftpb Snapshot.  Load walks newest-first, quarantining
+unreadable files as ``.broken`` so one corruption never masks an older
+good snapshot.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable
+
+from ..crc import value as crc_value
+from ..wire import SnapPb, Snapshot, is_empty_snap
+from ..wire.proto import ProtoError
+
+log = logging.getLogger(__name__)
+
+SNAP_SUFFIX = ".snap"
+
+
+class SnapError(Exception):
+    pass
+
+
+class NoSnapshotError(SnapError):
+    """No available snapshot (ErrNoSnapshot)."""
+
+
+class SnapCRCMismatchError(SnapError):
+    """Whole-file CRC mismatch (ErrCRCMismatch)."""
+
+
+class SnapEmptyError(SnapError):
+    """Empty snapshot file or payload."""
+
+
+def snap_name(term: int, index: int) -> str:
+    return f"{term:016x}-{index:016x}{SNAP_SUFFIX}"
+
+
+class Snapshotter:
+    """``crc_fn`` computes CRC32C of a blob from a zero seed; the
+    default is the host path and the device kernel
+    (ops.crc_kernel.device_crc32c) drops in for large blobs."""
+
+    def __init__(self, dirpath: str,
+                 crc_fn: Callable[[bytes], int] | None = None):
+        self.dir = dirpath
+        self.crc_fn = crc_fn or crc_value
+
+    def save_snap(self, snapshot: Snapshot) -> None:
+        """No-op for empty snapshots (snapshotter.go:39-44)."""
+        if is_empty_snap(snapshot):
+            return
+        self._save(snapshot)
+
+    def _save(self, snapshot: Snapshot) -> None:
+        fname = snap_name(snapshot.term, snapshot.index)
+        b = snapshot.marshal()
+        crc = self.crc_fn(b)
+        d = SnapPb(crc=crc, data=b).marshal()
+        with open(os.path.join(self.dir, fname), "wb") as f:
+            f.write(d)
+
+    def load(self) -> Snapshot:
+        """Newest-first, falling back across corrupt files
+        (snapshotter.go:62-74)."""
+        names = self._snap_names()
+        err: Exception = NoSnapshotError(self.dir)
+        for name in names:
+            try:
+                return self._load_snap(name)
+            except SnapError as e:
+                err = e
+        raise err
+
+    def _load_snap(self, name: str) -> Snapshot:
+        """Any failure quarantines the file (snapshotter.go:81-85
+        defers renameBroken on every error path, reads included)."""
+        fpath = os.path.join(self.dir, name)
+        try:
+            with open(fpath, "rb") as f:
+                b = f.read()
+        except OSError as e:
+            log.warning("snapshotter cannot read file %s: %s", name, e)
+            self._rename_broken(fpath)
+            raise SnapError(str(e)) from e
+        try:
+            if not b:
+                raise SnapEmptyError(name)
+            serialized = SnapPb.unmarshal(b)
+            if serialized.data is None:
+                raise SnapEmptyError(name)
+            crc = self.crc_fn(serialized.data)
+            if crc != serialized.crc:
+                log.warning("corrupted snapshot file %s: crc mismatch", name)
+                raise SnapCRCMismatchError(name)
+            try:
+                return Snapshot.unmarshal(serialized.data)
+            except ProtoError as e:
+                raise SnapError(f"corrupted snapshot {name}: {e}") from e
+        except ProtoError as e:
+            log.warning("corrupted snapshot file %s: %s", name, e)
+            self._rename_broken(fpath)
+            raise SnapError(str(e)) from e
+        except SnapError:
+            self._rename_broken(fpath)
+            raise
+
+    def _snap_names(self) -> list[str]:
+        """Snapshot filenames newest-first (snapshotter.go:115-131)."""
+        names = os.listdir(self.dir)
+        snaps = [n for n in names if n.endswith(SNAP_SUFFIX)]
+        for n in names:
+            if not n.endswith(SNAP_SUFFIX):
+                log.warning("unexpected non-snap file %s", n)
+        if not snaps:
+            raise NoSnapshotError(self.dir)
+        return sorted(snaps, reverse=True)
+
+    @staticmethod
+    def _rename_broken(path: str) -> None:
+        broken = path + ".broken"
+        try:
+            os.rename(path, broken)
+        except OSError as e:  # pragma: no cover
+            log.warning("cannot rename broken snapshot %s: %s", path, e)

@@ -1,0 +1,46 @@
+"""L3* write-ahead log: durable record log + batched device replay.
+
+``WAL`` is the host read/write path (reference wal/wal.go seam:
+Create/OpenAtIndex/ReadAll/Save/SaveEntry/SaveState/Cut/Sync/Close).
+``replay`` adds the TPU-native bulk path: parallel record framing +
+device CRC verification + GF(2) chain fix-up instead of the
+reference's strictly-sequential decode loop.
+"""
+
+from .errors import (
+    CRCMismatchError,
+    FileNotFoundError_,
+    IndexNotFoundError,
+    MetadataConflictError,
+    WALError,
+)
+from .wal import (
+    CRC_TYPE,
+    ENTRY_TYPE,
+    METADATA_TYPE,
+    STATE_TYPE,
+    WAL,
+    exist,
+    parse_wal_name,
+    search_index,
+    is_valid_seq,
+    wal_name,
+)
+
+__all__ = [
+    "WAL",
+    "exist",
+    "wal_name",
+    "parse_wal_name",
+    "search_index",
+    "is_valid_seq",
+    "METADATA_TYPE",
+    "ENTRY_TYPE",
+    "STATE_TYPE",
+    "CRC_TYPE",
+    "WALError",
+    "MetadataConflictError",
+    "FileNotFoundError_",
+    "IndexNotFoundError",
+    "CRCMismatchError",
+]

@@ -1,4 +1,19 @@
-"""WAL error vocabulary (reference wal/wal.go:44-49)."""
+"""WAL error vocabulary (reference wal/wal.go:44-49).
+
+``CRCMismatchError`` is re-exported from the wire layer, where the
+reference also defines it (wal/walpb/record.go:20), so the L2 codec
+never imports upward.
+"""
+
+from ..wire.proto import CRCMismatchError
+
+__all__ = [
+    "WALError",
+    "MetadataConflictError",
+    "FileNotFoundError_",
+    "IndexNotFoundError",
+    "CRCMismatchError",
+]
 
 
 class WALError(Exception):
@@ -15,7 +30,3 @@ class FileNotFoundError_(WALError):
 
 class IndexNotFoundError(WALError):
     """Requested index not present in the WAL (ErrIndexNotFound)."""
-
-
-class CRCMismatchError(WALError):
-    """Rolling checksum mismatch (ErrCRCMismatch)."""

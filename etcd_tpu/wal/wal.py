@@ -1,0 +1,360 @@
+"""Write-ahead log: append-only segmented record log with rolling CRC.
+
+Host-path port of the reference's wal package semantics
+(wal/wal.go:57-293): a WAL is either in read mode or append mode; a
+newly created WAL appends, a just-opened WAL reads, and becomes
+appendable only after ``read_all`` drains it.  Files are named
+``%016x-%016x.wal`` (seq, index) (wal/util.go:86-88); each file starts
+with a crcType record carrying the rolling CRC at the cut point
+(wal/wal.go:93,234) followed by a metadata record, so segments chain.
+
+Record framing (wal/encoder.go:25-37, decoder.go:28-47): little-endian
+int64 length prefix, then the marshaled walpb Record.  The rolling
+digest covers record *data* only — the framing and record envelope are
+protected by the fact that a corrupted envelope fails to unmarshal.
+
+The batched device replay lives in ``replay.py``; this module is the
+durable read/write seam shared by both paths.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import BinaryIO
+
+from ..crc import Digest
+from ..wire import Entry, HardState, Record
+from .errors import (
+    CRCMismatchError,
+    FileNotFoundError_,
+    IndexNotFoundError,
+    MetadataConflictError,
+    WALError,
+)
+
+# record types (reference wal/wal.go:35-39)
+METADATA_TYPE = 1
+ENTRY_TYPE = 2
+STATE_TYPE = 3
+CRC_TYPE = 4
+
+_PRIVATE_DIR_MODE = 0o700
+_LEN_STRUCT = struct.Struct("<q")
+
+
+def wal_name(seq: int, index: int) -> str:
+    return f"{seq:016x}-{index:016x}.wal"
+
+
+def parse_wal_name(name: str) -> tuple[int, int]:
+    """Raises ValueError on non-WAL names (reference wal/util.go:77-84)."""
+    if not name.endswith(".wal"):
+        raise ValueError(f"bad wal name: {name}")
+    stem = name[:-4]
+    seq_s, _, index_s = stem.partition("-")
+    if len(seq_s) != 16 or len(index_s) != 16:
+        raise ValueError(f"bad wal name: {name}")
+    return int(seq_s, 16), int(index_s, 16)
+
+
+def check_wal_names(names: list[str]) -> list[str]:
+    out = []
+    for name in names:
+        try:
+            parse_wal_name(name)
+        except ValueError:
+            continue
+        out.append(name)
+    return out
+
+
+def search_index(names: list[str], index: int) -> int | None:
+    """Last position whose raft-index section is <= index; names sorted
+    (reference wal/util.go:20-32)."""
+    for i in range(len(names) - 1, -1, -1):
+        _, cur_index = parse_wal_name(names[i])
+        if index >= cur_index:
+            return i
+    return None
+
+
+def is_valid_seq(names: list[str]) -> bool:
+    """Sequence numbers must increase continuously (wal/util.go:36-49)."""
+    last_seq = 0
+    for name in names:
+        cur_seq, _ = parse_wal_name(name)
+        if last_seq != 0 and last_seq != cur_seq - 1:
+            return False
+        last_seq = cur_seq
+    return True
+
+
+def exist(dirpath: str) -> bool:
+    try:
+        return len(os.listdir(dirpath)) != 0
+    except OSError:
+        return False
+
+
+def _open_append_0600(path: str) -> BinaryIO:
+    """Segment files carry owner-only mode like the reference
+    (wal/wal.go:82,222 pass 0600)."""
+    fd = os.open(path, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o600)
+    return os.fdopen(fd, "ab")
+
+
+class _Encoder:
+    """Rolling-CRC record encoder (reference wal/encoder.go:13-45)."""
+
+    def __init__(self, f: BinaryIO, prev_crc: int):
+        self.f = f
+        self.crc = Digest(prev_crc)
+
+    def encode(self, rec: Record) -> None:
+        if rec.data is not None:
+            self.crc.write(rec.data)
+        rec.crc = self.crc.sum32()
+        data = rec.marshal()
+        self.f.write(_LEN_STRUCT.pack(len(data)))
+        self.f.write(data)
+
+
+class _Decoder:
+    """Sequential record decoder over a chain of segment files
+    (reference wal/decoder.go:14-59 + MultiReadCloser)."""
+
+    def __init__(self, files: list[BinaryIO]):
+        self.files = files
+        self.fi = 0
+        self.crc = Digest(0)
+
+    def _read(self, n: int) -> bytes:
+        """ReadFull across the file chain; b'' at a clean stream end."""
+        chunks = []
+        need = n
+        while need > 0:
+            if self.fi >= len(self.files):
+                break
+            chunk = self.files[self.fi].read(need)
+            if not chunk:
+                self.fi += 1
+                continue
+            chunks.append(chunk)
+            need -= len(chunk)
+        return b"".join(chunks)
+
+    def decode(self) -> Record | None:
+        """Next record, or None at a clean EOF.  A partial trailing
+        record raises (the reference surfaces io.ErrUnexpectedEOF)."""
+        header = self._read(8)
+        if len(header) == 0:
+            return None
+        if len(header) < 8:
+            raise WALError("unexpected EOF in record length")
+        (length,) = _LEN_STRUCT.unpack(header)
+        if length < 0:
+            raise WALError(f"negative record length {length}")
+        data = self._read(length)
+        if len(data) < length:
+            raise WALError("unexpected EOF in record body")
+        rec = Record.unmarshal(data)
+        # skip crc checking if the record type is crcType
+        # (wal/decoder.go:41-43)
+        if rec.type == CRC_TYPE:
+            return rec
+        if rec.data is not None:
+            self.crc.write(rec.data)
+        rec.validate(self.crc.sum32())
+        return rec
+
+    def update_crc(self, prev_crc: int) -> None:
+        self.crc = Digest(prev_crc)
+
+    def last_crc(self) -> int:
+        return self.crc.sum32()
+
+    def close(self) -> None:
+        for f in self.files:
+            f.close()
+
+
+class WAL:
+    """Logical representation of the stable storage (wal/wal.go:57-68)."""
+
+    def __init__(self) -> None:
+        self.dir = ""
+        self.md: bytes | None = None
+        self.ri = 0  # index of entry to start reading
+        self.decoder: _Decoder | None = None
+        self.f: BinaryIO | None = None  # file opened for appending
+        self.seq = 0
+        self.enti = 0  # index of the last entry saved
+        self.encoder: _Encoder | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @classmethod
+    def create(cls, dirpath: str, metadata: bytes) -> "WAL":
+        """Create an append-mode WAL; metadata heads every segment
+        (reference wal/wal.go:72-100)."""
+        if exist(dirpath):
+            raise FileExistsError(dirpath)
+        os.makedirs(dirpath, mode=_PRIVATE_DIR_MODE, exist_ok=True)
+        p = os.path.join(dirpath, wal_name(0, 0))
+        f = _open_append_0600(p)
+        w = cls()
+        w.dir = dirpath
+        w.md = metadata
+        w.seq = 0
+        w.f = f
+        w.encoder = _Encoder(f, 0)
+        w._save_crc(0)
+        w.encoder.encode(Record(type=METADATA_TYPE, data=metadata))
+        return w
+
+    @classmethod
+    def open_at_index(cls, dirpath: str, index: int) -> "WAL":
+        """Open read-mode at ``index``; the caller must ``read_all``
+        before appending (reference wal/wal.go:108-159)."""
+        try:
+            names = os.listdir(dirpath)
+        except OSError as e:
+            raise FileNotFoundError_(str(e)) from e
+        names = sorted(check_wal_names(names))
+        if not names:
+            raise FileNotFoundError_(dirpath)
+
+        name_index = search_index(names, index)
+        if name_index is None or not is_valid_seq(names[name_index:]):
+            raise FileNotFoundError_(f"no wal file covers index {index}")
+
+        files = [open(os.path.join(dirpath, n), "rb")
+                 for n in names[name_index:]]
+        seq, _ = parse_wal_name(names[-1])
+        f = open(os.path.join(dirpath, names[-1]), "ab")
+
+        w = cls()
+        w.dir = dirpath
+        w.ri = index
+        w.decoder = _Decoder(files)
+        w.f = f
+        w.seq = seq
+        return w
+
+    # -- read --------------------------------------------------------------
+
+    def read_all(self) -> tuple[bytes | None, HardState, list[Entry]]:
+        """Drain the WAL; afterwards it accepts appends
+        (reference wal/wal.go:164-216)."""
+        if self.decoder is None:
+            raise WALError("wal not in read mode")
+        metadata: bytes | None = None
+        state = HardState()
+        ents: list[Entry] = []
+
+        while (rec := self.decoder.decode()) is not None:
+            if rec.type == ENTRY_TYPE:
+                e = Entry.unmarshal(rec.data or b"")
+                if e.index >= self.ri:
+                    # dedup-by-index: an uncommitted tail may be
+                    # overwritten after restart (wal/wal.go:171-175);
+                    # a gap would slice out of range in the reference
+                    if e.index - self.ri > len(ents):
+                        raise WALError(
+                            f"entry index gap: {e.index} after "
+                            f"{len(ents)} entries from {self.ri}")
+                    del ents[e.index - self.ri:]
+                    ents.append(e)
+                self.enti = e.index
+            elif rec.type == STATE_TYPE:
+                state = HardState.unmarshal(rec.data or b"")
+            elif rec.type == METADATA_TYPE:
+                if metadata is not None and metadata != rec.data:
+                    raise MetadataConflictError()
+                metadata = rec.data
+            elif rec.type == CRC_TYPE:
+                crc = self.decoder.crc.sum32()
+                # a zero running crc means a fresh decoder (file head);
+                # otherwise the chain must match (wal/wal.go:184-191)
+                if crc != 0 and rec.crc != crc:
+                    raise CRCMismatchError(
+                        f"segment boundary crc: record={rec.crc:#x} "
+                        f"running={crc:#x}")
+                self.decoder.update_crc(rec.crc)
+            else:
+                raise WALError(f"unexpected block type {rec.type}")
+
+        if self.enti < self.ri:
+            raise IndexNotFoundError(
+                f"last entry {self.enti} < requested {self.ri}")
+
+        # close decoder, disable reading; chain the encoder's crc
+        last_crc = self.decoder.last_crc()
+        self.decoder.close()
+        self.decoder = None
+        self.ri = 0
+        self.md = metadata
+        self.encoder = _Encoder(self.f, last_crc)
+        return metadata, state, ents
+
+    # -- append ------------------------------------------------------------
+
+    def cut(self) -> None:
+        """Close the current segment and start seq+1 at enti+1
+        (reference wal/wal.go:219-238)."""
+        if self.encoder is None:
+            raise WALError("wal not in append mode")
+        fpath = os.path.join(self.dir, wal_name(self.seq + 1, self.enti + 1))
+        f = _open_append_0600(fpath)
+        self.sync()
+        self.f.close()
+
+        self.f = f
+        self.seq += 1
+        prev_crc = self.encoder.crc.sum32()
+        self.encoder = _Encoder(self.f, prev_crc)
+        self._save_crc(prev_crc)
+        self.encoder.encode(Record(type=METADATA_TYPE, data=self.md))
+
+    def sync(self) -> None:
+        if self.f is not None:
+            self.f.flush()
+            os.fsync(self.f.fileno())
+
+    def close(self) -> None:
+        if self.decoder is not None:
+            self.decoder.close()
+            self.decoder = None
+        if self.f is not None:
+            if self.encoder is not None:
+                self.sync()
+            self.f.close()
+            self.f = None
+
+    def save_entry(self, e: Entry) -> None:
+        if self.encoder is None:
+            raise WALError("wal not in append mode (read_all first)")
+        rec = Record(type=ENTRY_TYPE, data=e.marshal())
+        self.encoder.encode(rec)
+        self.enti = e.index
+
+    def save_state(self, st: HardState) -> None:
+        from ..wire import is_empty_hard_state
+
+        if is_empty_hard_state(st):
+            return
+        if self.encoder is None:
+            raise WALError("wal not in append mode (read_all first)")
+        self.encoder.encode(Record(type=STATE_TYPE, data=st.marshal()))
+
+    def save(self, st: HardState, ents: list[Entry]) -> None:
+        """HardState + entries + fsync — the Ready-contract durability
+        step (reference wal/wal.go:281-288)."""
+        self.save_state(st)
+        for e in ents:
+            self.save_entry(e)
+        self.sync()
+
+    def _save_crc(self, prev_crc: int) -> None:
+        self.encoder.encode(Record(type=CRC_TYPE, crc=prev_crc))

@@ -29,6 +29,11 @@ class ProtoError(ValueError):
     pass
 
 
+class CRCMismatchError(ProtoError):
+    """Record CRC mismatch.  Lives at the wire layer like the
+    reference's walpb.ErrCRCMismatch (wal/walpb/record.go:20)."""
+
+
 # ---------------------------------------------------------------------------
 # varint primitives
 # ---------------------------------------------------------------------------
@@ -434,8 +439,6 @@ class Record:
     def validate(self, crc: int) -> None:
         """Reference wal/walpb/record.go:25 — raise on CRC mismatch."""
         if self.crc != crc:
-            from ..wal.errors import CRCMismatchError
-
             raise CRCMismatchError(
                 f"crc mismatch: record={self.crc:#x} computed={crc:#x}")
 

@@ -1,0 +1,96 @@
+"""Snapshotter tests (reference snap/snapshotter_test.go patterns:
+round-trip, byte-flip corruption, .broken quarantine, newest-wins)."""
+
+import os
+
+import pytest
+
+from etcd_tpu.snap import (
+    NoSnapshotError,
+    SnapCRCMismatchError,
+    Snapshotter,
+)
+from etcd_tpu.snap.snapshotter import snap_name
+from etcd_tpu.wire import Snapshot
+
+
+SNAP = Snapshot(data=b"some snapshot", nodes=[1, 2, 3], index=1, term=1)
+
+
+def test_save_and_load(tmp_path):
+    ss = Snapshotter(str(tmp_path))
+    ss.save_snap(SNAP)
+    assert os.listdir(str(tmp_path)) == [snap_name(1, 1)]
+    out = ss.load()
+    assert out == SNAP
+
+
+def test_empty_snapshot_not_saved(tmp_path):
+    ss = Snapshotter(str(tmp_path))
+    ss.save_snap(Snapshot())
+    assert os.listdir(str(tmp_path)) == []
+    with pytest.raises(NoSnapshotError):
+        ss.load()
+
+
+def test_corrupt_crc_detected_and_quarantined(tmp_path):
+    ss = Snapshotter(str(tmp_path))
+    ss.save_snap(SNAP)
+    fpath = os.path.join(str(tmp_path), snap_name(1, 1))
+    blob = bytearray(open(fpath, "rb").read())
+    blob[-1] ^= 0xFF
+    open(fpath, "wb").write(bytes(blob))
+
+    with pytest.raises(SnapCRCMismatchError):
+        ss.load()
+    # quarantined as .broken (snapshotter.go:145-150)
+    assert os.listdir(str(tmp_path)) == [snap_name(1, 1) + ".broken"]
+
+
+def test_fallback_to_older_good_snapshot(tmp_path):
+    ss = Snapshotter(str(tmp_path))
+    old = Snapshot(data=b"old", nodes=[1], index=1, term=1)
+    new = Snapshot(data=b"new", nodes=[1], index=5, term=2)
+    ss.save_snap(old)
+    ss.save_snap(new)
+    # corrupt the newest
+    fpath = os.path.join(str(tmp_path), snap_name(2, 5))
+    blob = bytearray(open(fpath, "rb").read())
+    blob[-1] ^= 0xFF
+    open(fpath, "wb").write(bytes(blob))
+
+    out = ss.load()
+    assert out == old
+    names = sorted(os.listdir(str(tmp_path)))
+    assert snap_name(2, 5) + ".broken" in names
+
+
+def test_newest_wins(tmp_path):
+    ss = Snapshotter(str(tmp_path))
+    for i in (1, 3, 2):
+        ss.save_snap(Snapshot(data=b"v%d" % i, nodes=[1], index=i, term=1))
+    assert ss.load().data == b"v3"
+
+
+def test_empty_file_quarantined(tmp_path):
+    ss = Snapshotter(str(tmp_path))
+    ss.save_snap(SNAP)
+    open(os.path.join(str(tmp_path), snap_name(9, 9)), "wb").close()
+    out = ss.load()  # falls back over the empty newest file
+    assert out == SNAP
+    assert snap_name(9, 9) + ".broken" in os.listdir(str(tmp_path))
+
+
+def test_custom_crc_fn_seam(tmp_path):
+    # the device-hash path plugs in behind crc_fn
+    calls = []
+
+    def crc_fn(b):
+        calls.append(len(b))
+        from etcd_tpu.crc import value
+        return value(b)
+
+    ss = Snapshotter(str(tmp_path), crc_fn=crc_fn)
+    ss.save_snap(SNAP)
+    assert ss.load() == SNAP
+    assert len(calls) == 2  # one save, one load

@@ -1,0 +1,227 @@
+"""WAL tests, mirroring the reference's round-trip-against-real-temp-dir
+style (wal/wal_test.go:30-340)."""
+
+import os
+
+import pytest
+
+from etcd_tpu.wal import (
+    CRCMismatchError,
+    FileNotFoundError_,
+    IndexNotFoundError,
+    MetadataConflictError,
+    WAL,
+    WALError,
+    is_valid_seq,
+    parse_wal_name,
+    search_index,
+    wal_name,
+)
+from etcd_tpu.wire import Entry, HardState
+
+
+def ent(index, term=1, data=b""):
+    return Entry(term=term, index=index, data=data)
+
+
+def test_wal_names():
+    assert wal_name(3, 0x10) == "0000000000000003-0000000000000010.wal"
+    assert parse_wal_name("0000000000000003-0000000000000010.wal") == (3, 16)
+    with pytest.raises(ValueError):
+        parse_wal_name("nope.wal")
+    with pytest.raises(ValueError):
+        parse_wal_name("0000000000000003-0000000000000010.snap")
+
+
+def test_search_index_and_seq():
+    names = [wal_name(0, 0), wal_name(1, 10), wal_name(2, 20)]
+    assert search_index(names, 5) == 0
+    assert search_index(names, 10) == 1
+    assert search_index(names, 100) == 2
+    assert is_valid_seq(names)
+    assert not is_valid_seq([wal_name(1, 10), wal_name(3, 20)])
+    # reference quirk: the zero-seq sentinel masks a gap right after
+    # seq 0 (wal/util.go:43 `lastSeq != 0` check)
+    assert is_valid_seq([wal_name(0, 0), wal_name(2, 20)])
+
+
+def test_create_and_read_back(tmp_path):
+    p = str(tmp_path / "wal")
+    w = WAL.create(p, b"metadata")
+    st = HardState(term=1, vote=2, commit=1)
+    w.save(st, [ent(0, term=0), ent(1, data=b"first")])
+    w.close()
+
+    w2 = WAL.open_at_index(p, 0)
+    md, state, ents = w2.read_all()
+    assert md == b"metadata"
+    assert state == st
+    assert ents == [ent(0, term=0), ent(1, data=b"first")]
+    w2.close()
+
+
+def test_create_refuses_existing(tmp_path):
+    p = str(tmp_path / "wal")
+    WAL.create(p, b"m").close()
+    with pytest.raises(FileExistsError):
+        WAL.create(p, b"m")
+
+
+def test_append_after_read(tmp_path):
+    p = str(tmp_path / "wal")
+    w = WAL.create(p, b"m")
+    w.save(HardState(term=1), [ent(0, term=0), ent(1)])
+    w.close()
+
+    w = WAL.open_at_index(p, 0)
+    w.read_all()
+    w.save(HardState(term=1, commit=1), [ent(2, data=b"more")])
+    w.close()
+
+    w = WAL.open_at_index(p, 0)
+    _, state, ents = w.read_all()
+    assert [e.index for e in ents] == [0, 1, 2]
+    assert ents[2].data == b"more"
+    assert state.commit == 1
+    w.close()
+
+
+def test_cut_creates_chained_segments(tmp_path):
+    p = str(tmp_path / "wal")
+    w = WAL.create(p, b"meta")
+    w.save(HardState(term=1), [ent(0, term=0), ent(1)])
+    w.cut()
+    w.save(HardState(term=1), [ent(2)])
+    w.cut()
+    w.save(HardState(term=1), [ent(3, data=b"z")])
+    w.close()
+
+    names = sorted(os.listdir(p))
+    assert names == [wal_name(0, 0), wal_name(1, 2), wal_name(2, 3)]
+
+    w = WAL.open_at_index(p, 0)
+    md, _, ents = w.read_all()
+    assert md == b"meta"
+    assert [e.index for e in ents] == [0, 1, 2, 3]
+    w.close()
+
+
+def test_open_at_later_index_skips_segments(tmp_path):
+    p = str(tmp_path / "wal")
+    w = WAL.create(p, b"m")
+    w.save_entry(ent(0, term=0))
+    for i in range(1, 11):
+        w.save(HardState(term=1, commit=i), [ent(i)])
+        if i % 3 == 0:
+            w.cut()
+    w.close()
+
+    w = WAL.open_at_index(p, 5)
+    _, _, ents = w.read_all()
+    assert ents[0].index == 5
+    assert ents[-1].index == 10
+    w.close()
+
+
+def test_open_at_uncommitted_index_fails(tmp_path):
+    # requested index was never written -> ErrIndexNotFound
+    # (wal/wal.go:202-205, wal_test.go:326)
+    p = str(tmp_path / "wal")
+    w = WAL.create(p, b"m")
+    w.save(HardState(term=1), [ent(1)])
+    w.close()
+    w = WAL.open_at_index(p, 2)
+    with pytest.raises(IndexNotFoundError):
+        w.read_all()
+    w.close()
+
+
+def test_open_missing_dir_fails(tmp_path):
+    with pytest.raises(FileNotFoundError_):
+        WAL.open_at_index(str(tmp_path / "nope"), 0)
+
+
+def test_entry_overwrite_by_index(tmp_path):
+    # an uncommitted tail gets overwritten after restart
+    # (wal/wal.go:171-175)
+    p = str(tmp_path / "wal")
+    w = WAL.create(p, b"m")
+    w.save(HardState(term=1), [ent(0, term=0), ent(1, term=1),
+                               ent(2, term=1, data=b"old"), ent(3, term=1)])
+    w.close()
+    w = WAL.open_at_index(p, 0)
+    w.read_all()
+    # overwrite index 2 with a new term — replay keeps only the last
+    w.save(HardState(term=2), [ent(2, term=2, data=b"new")])
+    w.close()
+
+    w = WAL.open_at_index(p, 0)
+    _, _, ents = w.read_all()
+    assert [e.index for e in ents] == [0, 1, 2]
+    assert ents[2].data == b"new" and ents[2].term == 2
+    w.close()
+
+
+def test_corrupt_record_detected(tmp_path):
+    p = str(tmp_path / "wal")
+    w = WAL.create(p, b"m")
+    w.save(HardState(term=1), [ent(0, term=0), ent(1, data=b"payload-one")])
+    w.save(HardState(term=1), [ent(2, data=b"payload-two")])
+    w.close()
+
+    fname = os.path.join(p, wal_name(0, 0))
+    blob = bytearray(open(fname, "rb").read())
+    # flip a byte inside the last record's payload region
+    blob[-3] ^= 0xFF
+    open(fname, "wb").write(bytes(blob))
+
+    w = WAL.open_at_index(p, 0)
+    with pytest.raises((CRCMismatchError, WALError)):
+        w.read_all()
+    w.close()
+
+
+def test_truncated_tail_detected(tmp_path):
+    p = str(tmp_path / "wal")
+    w = WAL.create(p, b"m")
+    w.save(HardState(term=1), [ent(0, term=0), ent(1, data=b"x" * 100)])
+    w.close()
+    fname = os.path.join(p, wal_name(0, 0))
+    blob = open(fname, "rb").read()
+    open(fname, "wb").write(blob[:-20])
+
+    w = WAL.open_at_index(p, 0)
+    with pytest.raises(WALError):
+        w.read_all()
+    w.close()
+
+
+def test_metadata_conflict_detected(tmp_path):
+    p = str(tmp_path / "wal")
+    w = WAL.create(p, b"aaaa")
+    w.save(HardState(term=1), [ent(0, term=0), ent(1)])
+    w.close()
+    # hand-append a second segment with different metadata
+    w = WAL.open_at_index(p, 0)
+    w.read_all()
+    w.md = b"bbbb"
+    w.cut()
+    w.save(HardState(term=1), [ent(2)])
+    w.close()
+
+    w = WAL.open_at_index(p, 0)
+    with pytest.raises(MetadataConflictError):
+        w.read_all()
+    w.close()
+
+
+def test_state_must_precede_entries_not_required_but_last_wins(tmp_path):
+    p = str(tmp_path / "wal")
+    w = WAL.create(p, b"m")
+    w.save(HardState(term=1, commit=0), [ent(0, term=0), ent(1)])
+    w.save(HardState(term=3, commit=1), [])
+    w.close()
+    w = WAL.open_at_index(p, 0)
+    _, state, _ = w.read_all()
+    assert state.term == 3 and state.commit == 1
+    w.close()
