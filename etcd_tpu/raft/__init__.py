@@ -1,0 +1,48 @@
+"""L4* Raft consensus.
+
+- ``core``: the pure single-group state machine (reference raft/raft.go)
+  — the executable specification.
+- ``log``: contiguous entry log with offset (reference raft/log.go).
+- ``node``: serialized driver emitting Ready batches (raft/node.go).
+- ``batched``: the TPU-native engine — the same transition relation
+  over [G, ...] arrays for tens of thousands of co-hosted groups.
+"""
+
+from .core import (
+    NONE,
+    Progress,
+    Raft,
+    RaftPanicError,
+    SoftState,
+    STATE_CANDIDATE,
+    STATE_FOLLOWER,
+    STATE_LEADER,
+)
+from .log import LogError, RaftLog
+from .node import (
+    Node,
+    Peer,
+    Ready,
+    StoppedError,
+    restart_node,
+    start_node,
+)
+
+__all__ = [
+    "NONE",
+    "Raft",
+    "RaftLog",
+    "RaftPanicError",
+    "LogError",
+    "Progress",
+    "SoftState",
+    "STATE_FOLLOWER",
+    "STATE_CANDIDATE",
+    "STATE_LEADER",
+    "Node",
+    "Peer",
+    "Ready",
+    "StoppedError",
+    "start_node",
+    "restart_node",
+]

@@ -1,11 +1,12 @@
 """WAL error vocabulary (reference wal/wal.go:44-49).
 
-``CRCMismatchError`` is re-exported from the wire layer, where the
-reference also defines it (wal/walpb/record.go:20), so the L2 codec
-never imports upward.
+The wire layer owns the base CRC error (like walpb.ErrCRCMismatch,
+wal/walpb/record.go:20); the WAL's ``CRCMismatchError`` subclasses both
+it and ``WALError`` so callers can treat all replay corruption
+uniformly with ``except WALError``.
 """
 
-from ..wire.proto import CRCMismatchError
+from ..wire.proto import CRCMismatchError as WireCRCMismatchError
 
 __all__ = [
     "WALError",
@@ -18,6 +19,10 @@ __all__ = [
 
 class WALError(Exception):
     pass
+
+
+class CRCMismatchError(WALError, WireCRCMismatchError):
+    """Rolling checksum mismatch during replay (ErrCRCMismatch)."""
 
 
 class MetadataConflictError(WALError):

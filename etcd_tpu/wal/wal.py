@@ -165,7 +165,10 @@ class _Decoder:
             return rec
         if rec.data is not None:
             self.crc.write(rec.data)
-        rec.validate(self.crc.sum32())
+        if rec.crc != self.crc.sum32():
+            raise CRCMismatchError(
+                f"crc mismatch: record={rec.crc:#x} "
+                f"computed={self.crc.sum32():#x}")
         return rec
 
     def update_crc(self, prev_crc: int) -> None:

@@ -1,0 +1,858 @@
+"""Raft core SM tests — a port of the reference's table-driven suite
+(raft/raft_test.go) including the message-shuffling fake ``network``
+pump (raft_test.go:1203-1315) and the log-diff comparison
+(diff_test.go:44-51).
+"""
+
+import random
+
+import pytest
+
+from etcd_tpu.raft import (
+    NONE,
+    Progress,
+    Raft,
+    RaftPanicError,
+    STATE_CANDIDATE,
+    STATE_FOLLOWER,
+    STATE_LEADER,
+)
+from etcd_tpu.raft.core import (
+    _step_candidate,
+    _step_follower,
+    _step_leader,
+)
+from etcd_tpu.raft.log import DEFAULT_COMPACT_THRESHOLD, RaftLog
+from etcd_tpu.wire import (
+    ENTRY_CONF_CHANGE,
+    ENTRY_NORMAL,
+    Entry,
+    MSG_APP,
+    MSG_APP_RESP,
+    MSG_BEAT,
+    MSG_DENIED,
+    MSG_HUP,
+    MSG_PROP,
+    MSG_SNAP,
+    MSG_VOTE,
+    Message,
+    Snapshot,
+)
+
+
+def msg(**kw):
+    kw.setdefault("from_", 0)
+    return Message(**kw)
+
+
+def new_raft(id, peers, election=10, heartbeat=1):
+    return Raft(id, peers, election, heartbeat)
+
+
+def ltoa(l: RaftLog) -> str:
+    """Log-to-string for diffing (reference diff_test.go:44-51)."""
+    s = f"committed: {l.committed}\n"
+    s += f"applied:  {l.applied}\n"
+    for i, e in enumerate(l.ents):
+        s += f"#{i}: type={e.type} term={e.term} index={e.index} data={e.data!r}\n"
+    return s
+
+
+class BlackHole:
+    """nopStepper (reference raft_test.go:1311-1315)."""
+
+    def step(self, m):
+        pass
+
+    def read_messages(self):
+        return []
+
+
+NOP = BlackHole()
+
+
+def ents_preset(*terms):
+    """A raft whose log is preset from term values
+    (reference raft_test.go:1190-1201)."""
+    sm = Raft.__new__(Raft)
+    log = RaftLog()
+    log.ents = [Entry()] + [Entry(term=t) for t in terms]
+    sm.raft_log = log
+    sm.id = 0
+    sm.term = 0
+    sm.vote = NONE
+    sm.commit = 0
+    sm.prs = {}
+    sm.state = STATE_FOLLOWER
+    sm.votes = {}
+    sm.msgs = []
+    sm.lead = NONE
+    sm.pending_conf = False
+    sm.removed = {}
+    sm.elapsed = 0
+    sm.heartbeat_timeout = 1
+    sm.election_timeout = 10
+    sm._rng = random.Random(0)
+    sm._tick = sm._tick_election
+    sm._step = _step_follower
+    sm.reset(0)
+    return sm
+
+
+class Network:
+    """In-process cluster wired by a message pump
+    (reference raft_test.go:1203-1309)."""
+
+    def __init__(self, *peers):
+        size = len(peers)
+        addrs = [i + 1 for i in range(size)]
+        self.peers = {}
+        self.dropm = {}
+        self.ignorem = set()
+        self._rng = random.Random(1)
+        for i, p in enumerate(peers):
+            id = addrs[i]
+            if p is None:
+                self.peers[id] = new_raft(id, addrs)
+            elif isinstance(p, Raft):
+                p.id = id
+                p.prs = {a: Progress() for a in addrs}
+                p.reset(p.term)
+                self.peers[id] = p
+            elif isinstance(p, BlackHole):
+                self.peers[id] = p
+            else:
+                raise TypeError(p)
+
+    def send(self, *msgs):
+        queue = list(msgs)
+        while queue:
+            m = queue.pop(0)
+            p = self.peers[m.to]
+            p.step(m)
+            queue.extend(self.filter(p.read_messages()))
+
+    def drop(self, from_, to, perc):
+        self.dropm[(from_, to)] = perc
+
+    def cut(self, one, other):
+        self.drop(one, other, 1)
+        self.drop(other, one, 1)
+
+    def isolate(self, id):
+        for i in range(len(self.peers)):
+            nid = i + 1
+            if nid != id:
+                self.drop(id, nid, 1.0)
+                self.drop(nid, id, 1.0)
+
+    def ignore(self, t):
+        self.ignorem.add(t)
+
+    def recover(self):
+        self.dropm = {}
+        self.ignorem = set()
+
+    def filter(self, msgs):
+        mm = []
+        for m in msgs:
+            if m.type in self.ignorem:
+                continue
+            if m.type == MSG_HUP:
+                raise AssertionError("unexpected msgHup")
+            perc = self.dropm.get((m.from_, m.to), 0)
+            if self._rng.random() < perc:
+                continue
+            mm.append(m)
+        return mm
+
+
+def next_ents(r: Raft):
+    ents = r.raft_log.next_ents()
+    r.raft_log.reset_next_ents()
+    return ents
+
+
+# ---------------------------------------------------------------------------
+# elections (raft_test.go:27-54)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("peers,wstate", [
+    ((None, None, None), STATE_LEADER),
+    ((None, None, NOP), STATE_LEADER),
+    ((None, NOP, NOP), STATE_CANDIDATE),
+    ((None, NOP, NOP, None), STATE_CANDIDATE),
+    ((None, NOP, NOP, None, None), STATE_LEADER),
+    # three logs further along than 0
+    ((None, ents_preset(1), ents_preset(2), ents_preset(1, 3), None),
+     STATE_FOLLOWER),
+    # logs converge
+    ((ents_preset(1), None, ents_preset(2), ents_preset(1), None),
+     STATE_LEADER),
+])
+def test_leader_election(peers, wstate):
+    nt = Network(*peers)
+    nt.send(msg(from_=1, to=1, type=MSG_HUP))
+    sm = nt.peers[1]
+    assert sm.state == wstate
+    assert sm.term == 1
+
+
+def test_log_replication():
+    cases = [
+        (Network(None, None, None),
+         [msg(from_=1, to=1, type=MSG_PROP,
+              entries=[Entry(data=b"somedata")])],
+         2),
+        (Network(None, None, None),
+         [msg(from_=1, to=1, type=MSG_PROP,
+              entries=[Entry(data=b"somedata")]),
+          msg(from_=1, to=2, type=MSG_HUP),
+          msg(from_=1, to=2, type=MSG_PROP,
+              entries=[Entry(data=b"somedata")])],
+         4),
+    ]
+    for nt, msgs, wcommitted in cases:
+        nt.send(msg(from_=1, to=1, type=MSG_HUP))
+        for m in msgs:
+            nt.send(m)
+        props = [m for m in msgs if m.type == MSG_PROP]
+        for sm in nt.peers.values():
+            assert sm.raft_log.committed == wcommitted
+            ents = [e for e in next_ents(sm) if e.data]
+            for k, m in enumerate(props):
+                assert ents[k].data == m.entries[0].data
+
+
+def test_single_node_commit():
+    nt = Network(None)
+    nt.send(msg(from_=1, to=1, type=MSG_HUP))
+    nt.send(msg(from_=1, to=1, type=MSG_PROP, entries=[Entry(data=b"d")]))
+    nt.send(msg(from_=1, to=1, type=MSG_PROP, entries=[Entry(data=b"d")]))
+    assert nt.peers[1].raft_log.committed == 3
+
+
+def test_cannot_commit_without_new_term_entry():
+    # raft_test.go:131-170
+    nt = Network(None, None, None, None, None)
+    nt.send(msg(from_=1, to=1, type=MSG_HUP))
+    nt.cut(1, 3)
+    nt.cut(1, 4)
+    nt.cut(1, 5)
+    nt.send(msg(from_=1, to=1, type=MSG_PROP, entries=[Entry(data=b"d")]))
+    nt.send(msg(from_=1, to=1, type=MSG_PROP, entries=[Entry(data=b"d")]))
+    assert nt.peers[1].raft_log.committed == 1
+
+    nt.recover()
+    nt.ignore(MSG_APP)
+    nt.send(msg(from_=2, to=2, type=MSG_HUP))
+    assert nt.peers[2].raft_log.committed == 1
+
+    nt.recover()
+    nt.send(msg(from_=2, to=2, type=MSG_PROP, entries=[Entry(data=b"d")]))
+    assert nt.peers[2].raft_log.committed == 5
+
+
+def test_commit_without_new_term_entry():
+    # raft_test.go:174-203: the new leader's ChangeTerm entry commits
+    # everything
+    nt = Network(None, None, None, None, None)
+    nt.send(msg(from_=1, to=1, type=MSG_HUP))
+    nt.cut(1, 3)
+    nt.cut(1, 4)
+    nt.cut(1, 5)
+    nt.send(msg(from_=1, to=1, type=MSG_PROP, entries=[Entry(data=b"d")]))
+    nt.send(msg(from_=1, to=1, type=MSG_PROP, entries=[Entry(data=b"d")]))
+    assert nt.peers[1].raft_log.committed == 1
+    nt.recover()
+    nt.send(msg(from_=2, to=2, type=MSG_HUP))
+    assert nt.peers[2].raft_log.committed == 4
+
+
+def test_dueling_candidates():
+    a = new_raft(1, [1, 2, 3])
+    b = new_raft(2, [1, 2, 3])
+    c = new_raft(3, [1, 2, 3])
+    nt = Network(a, b, c)
+    nt.cut(1, 3)
+    nt.send(msg(from_=1, to=1, type=MSG_HUP))
+    nt.send(msg(from_=3, to=3, type=MSG_HUP))
+    nt.recover()
+    nt.send(msg(from_=3, to=3, type=MSG_HUP))
+
+    wlog = RaftLog()
+    wlog.ents = [Entry(), Entry(term=1, index=1)]
+    wlog.committed = 1
+    assert a.state == STATE_FOLLOWER and a.term == 2
+    assert b.state == STATE_FOLLOWER and b.term == 2
+    assert c.state == STATE_FOLLOWER and c.term == 2
+    assert ltoa(a.raft_log) == ltoa(wlog)
+    assert ltoa(b.raft_log) == ltoa(wlog)
+    assert ltoa(c.raft_log) == ltoa(RaftLog())
+
+
+def test_candidate_concede():
+    nt = Network(None, None, None)
+    nt.isolate(1)
+    nt.send(msg(from_=1, to=1, type=MSG_HUP))
+    nt.send(msg(from_=3, to=3, type=MSG_HUP))
+    nt.recover()
+    data = b"force follower"
+    nt.send(msg(from_=3, to=3, type=MSG_PROP, entries=[Entry(data=data)]))
+
+    a = nt.peers[1]
+    assert a.state == STATE_FOLLOWER
+    assert a.term == 1
+    wlog = RaftLog()
+    wlog.ents = [Entry(), Entry(term=1, index=1),
+                 Entry(term=1, index=2, data=data)]
+    wlog.committed = 2
+    for sm in nt.peers.values():
+        assert ltoa(sm.raft_log) == ltoa(wlog)
+
+
+def test_single_node_candidate():
+    nt = Network(None)
+    nt.send(msg(from_=1, to=1, type=MSG_HUP))
+    assert nt.peers[1].state == STATE_LEADER
+
+
+def test_old_messages():
+    nt = Network(None, None, None)
+    nt.send(msg(from_=1, to=1, type=MSG_HUP))
+    nt.send(msg(from_=2, to=2, type=MSG_HUP))
+    nt.send(msg(from_=1, to=1, type=MSG_HUP))
+    # pretend an old leader is trying to make progress
+    nt.send(msg(from_=1, to=1, type=MSG_APP, term=1,
+                entries=[Entry(term=1)]))
+
+    wlog = RaftLog()
+    wlog.ents = [Entry(), Entry(term=1, index=1), Entry(term=2, index=2),
+                 Entry(term=3, index=3)]
+    wlog.committed = 3
+    for sm in nt.peers.values():
+        assert ltoa(sm.raft_log) == ltoa(wlog)
+
+
+@pytest.mark.parametrize("peers,success", [
+    ((None, None, None), True),
+    ((None, None, NOP), True),
+    ((None, NOP, NOP), False),
+    ((None, NOP, NOP, None), False),
+    ((None, NOP, NOP, None, None), True),
+])
+def test_proposal(peers, success):
+    nt = Network(*peers)
+    data = b"somedata"
+
+    def send(m):
+        if success:
+            nt.send(m)
+        else:
+            try:
+                nt.send(m)
+            except RaftPanicError:
+                pass
+
+    send(msg(from_=1, to=1, type=MSG_HUP))
+    send(msg(from_=1, to=1, type=MSG_PROP, entries=[Entry(data=data)]))
+
+    wlog = RaftLog()
+    if success:
+        wlog.ents = [Entry(), Entry(term=1, index=1),
+                     Entry(term=1, index=2, data=data)]
+        wlog.committed = 2
+    base = ltoa(wlog)
+    for sm in nt.peers.values():
+        if isinstance(sm, Raft):
+            assert ltoa(sm.raft_log) == base
+    assert nt.peers[1].term == 1
+
+
+@pytest.mark.parametrize("peers", [
+    (None, None, None),
+    (None, None, NOP),
+])
+def test_proposal_by_proxy(peers):
+    nt = Network(*peers)
+    nt.send(msg(from_=1, to=1, type=MSG_HUP))
+    nt.send(msg(from_=2, to=2, type=MSG_PROP,
+                entries=[Entry(data=b"somedata")]))
+    wlog = RaftLog()
+    wlog.ents = [Entry(), Entry(term=1, index=1),
+                 Entry(term=1, index=2, data=b"somedata")]
+    wlog.committed = 2
+    base = ltoa(wlog)
+    for sm in nt.peers.values():
+        if isinstance(sm, Raft):
+            assert ltoa(sm.raft_log) == base
+    assert nt.peers[1].term == 1
+
+
+# ---------------------------------------------------------------------------
+# compaction + commit order statistic (raft_test.go:432-505)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("compacti,wpanic", [(1, False), (2, False),
+                                             (4, True)])
+def test_compact(compacti, wpanic):
+    nodes, removed, snapd = [1, 2, 3], [4, 5], b"some data"
+
+    sm = ents_preset(1, 1, 1)
+    sm.raft_log.committed = 2
+    sm.raft_log.applied = 2
+    sm.state = STATE_LEADER
+    for r in removed:
+        sm.remove_node(r)
+
+    if wpanic:
+        with pytest.raises(Exception):
+            sm.compact(compacti, nodes, snapd)
+        return
+    sm.compact(compacti, nodes, snapd)
+    assert sm.raft_log.offset == compacti
+    assert sorted(sm.raft_log.snapshot.nodes) == nodes
+    assert sm.raft_log.snapshot.data == snapd
+    assert sorted(sm.raft_log.snapshot.removed_nodes) == removed
+
+
+COMMIT_CASES = [
+    # (matches, log terms, smTerm, want)  — raft_test.go:465-491
+    ([1], [1], 1, 1),
+    ([1], [1], 2, 0),
+    ([2], [1, 2], 2, 2),
+    ([1], [2], 2, 1),
+    ([2, 1, 1], [1, 2], 1, 1),
+    ([2, 1, 1], [1, 1], 2, 0),
+    ([2, 1, 2], [1, 2], 2, 2),
+    ([2, 1, 2], [1, 1], 2, 0),
+    ([2, 1, 1, 1], [1, 2], 1, 1),
+    ([2, 1, 1, 1], [1, 1], 2, 0),
+    ([2, 1, 1, 2], [1, 2], 1, 1),
+    ([2, 1, 1, 2], [1, 1], 2, 0),
+    ([2, 1, 2, 2], [1, 2], 2, 2),
+    ([2, 1, 2, 2], [1, 1], 2, 0),
+]
+
+
+@pytest.mark.parametrize("matches,logterms,sm_term,want", COMMIT_CASES)
+def test_commit(matches, logterms, sm_term, want):
+    sm = ents_preset(*logterms)
+    sm.term = sm_term
+    sm.prs = {j: Progress(m, m + 1) for j, m in enumerate(matches)}
+    sm.maybe_commit()
+    assert sm.raft_log.committed == want
+
+
+@pytest.mark.parametrize("elapse,wprob,round_", [
+    (5, 0, False),
+    (13, 0.3, True),
+    (15, 0.5, True),
+    (18, 0.8, True),
+    (20, 1, False),
+])
+def test_is_election_timeout(elapse, wprob, round_):
+    sm = new_raft(1, [1])
+    sm.elapsed = elapse
+    c = sum(1 for _ in range(10000) if sm.is_election_timeout())
+    got = c / 10000.0
+    if round_:
+        got = round(got * 10) / 10.0
+    assert got == wprob
+
+
+# ---------------------------------------------------------------------------
+# step dispatch details (raft_test.go:539-779)
+# ---------------------------------------------------------------------------
+
+def test_step_ignore_old_term_msg():
+    called = []
+    sm = new_raft(1, [1])
+    sm._step = lambda r, m: called.append(m)
+    sm.term = 2
+    sm.step(msg(type=MSG_APP, term=1))
+    assert not called
+
+
+HANDLE_MSGAPP_CASES = [
+    # (m kwargs, windex, wcommit, wreject)
+    (dict(type=MSG_APP, term=2, log_term=3, index=2, commit=3), 2, 0, True),
+    (dict(type=MSG_APP, term=2, log_term=3, index=3, commit=3), 2, 0, True),
+    (dict(type=MSG_APP, term=2, log_term=1, index=1, commit=1), 2, 1, False),
+    (dict(type=MSG_APP, term=2, log_term=0, index=0, commit=1,
+          entries=[Entry(term=2)]), 1, 1, False),
+    (dict(type=MSG_APP, term=2, log_term=2, index=2, commit=3,
+          entries=[Entry(term=2), Entry(term=2)]), 4, 3, False),
+    (dict(type=MSG_APP, term=2, log_term=2, index=2, commit=4,
+          entries=[Entry(term=2)]), 3, 3, False),
+    (dict(type=MSG_APP, term=2, log_term=1, index=1, commit=4,
+          entries=[Entry(term=2)]), 2, 2, False),
+    (dict(type=MSG_APP, term=1, log_term=1, index=1, commit=3), 2, 1, False),
+    (dict(type=MSG_APP, term=1, log_term=1, index=1, commit=3,
+          entries=[Entry(term=2)]), 2, 2, False),
+    (dict(type=MSG_APP, term=2, log_term=2, index=2, commit=3), 2, 2, False),
+    (dict(type=MSG_APP, term=2, log_term=2, index=2, commit=4), 2, 2, False),
+]
+
+
+@pytest.mark.parametrize("mkw,windex,wcommit,wreject", HANDLE_MSGAPP_CASES)
+def test_handle_msgapp(mkw, windex, wcommit, wreject):
+    sm = ents_preset(1, 2)
+    sm.term = 2
+    sm.state = STATE_FOLLOWER
+    sm.handle_append_entries(msg(**mkw))
+    assert sm.raft_log.last_index() == windex
+    assert sm.raft_log.committed == wcommit
+    ms = sm.read_messages()
+    assert len(ms) == 1
+    assert ms[0].reject == wreject
+
+
+RECV_MSG_VOTE_CASES = [
+    (STATE_FOLLOWER, 0, 0, NONE, True),
+    (STATE_FOLLOWER, 0, 1, NONE, True),
+    (STATE_FOLLOWER, 0, 2, NONE, True),
+    (STATE_FOLLOWER, 0, 3, NONE, False),
+    (STATE_FOLLOWER, 1, 0, NONE, True),
+    (STATE_FOLLOWER, 1, 1, NONE, True),
+    (STATE_FOLLOWER, 1, 2, NONE, True),
+    (STATE_FOLLOWER, 1, 3, NONE, False),
+    (STATE_FOLLOWER, 2, 0, NONE, True),
+    (STATE_FOLLOWER, 2, 1, NONE, True),
+    (STATE_FOLLOWER, 2, 2, NONE, False),
+    (STATE_FOLLOWER, 2, 3, NONE, False),
+    (STATE_FOLLOWER, 3, 0, NONE, True),
+    (STATE_FOLLOWER, 3, 1, NONE, True),
+    (STATE_FOLLOWER, 3, 2, NONE, False),
+    (STATE_FOLLOWER, 3, 3, NONE, False),
+    (STATE_FOLLOWER, 3, 2, 2, False),
+    (STATE_FOLLOWER, 3, 2, 1, True),
+    (STATE_LEADER, 3, 3, 1, True),
+    (STATE_CANDIDATE, 3, 3, 1, True),
+]
+
+
+@pytest.mark.parametrize("state,i,term,vote_for,wreject",
+                         RECV_MSG_VOTE_CASES)
+def test_recv_msg_vote(state, i, term, vote_for, wreject):
+    sm = new_raft(1, [1])
+    sm.state = state
+    sm._step = {STATE_FOLLOWER: _step_follower,
+                STATE_CANDIDATE: _step_candidate,
+                STATE_LEADER: _step_leader}[state]
+    sm.vote = vote_for
+    log = RaftLog()
+    log.ents = [Entry(), Entry(term=2), Entry(term=2)]
+    sm.raft_log = log
+    sm.step(msg(type=MSG_VOTE, from_=2, index=i, log_term=term))
+    ms = sm.read_messages()
+    assert len(ms) == 1
+    assert ms[0].reject == wreject
+
+
+STATE_TRANSITION_CASES = [
+    (STATE_FOLLOWER, STATE_FOLLOWER, True, 1, NONE),
+    (STATE_FOLLOWER, STATE_CANDIDATE, True, 1, NONE),
+    (STATE_FOLLOWER, STATE_LEADER, False, 0, NONE),
+    (STATE_CANDIDATE, STATE_FOLLOWER, True, 0, NONE),
+    (STATE_CANDIDATE, STATE_CANDIDATE, True, 1, NONE),
+    (STATE_CANDIDATE, STATE_LEADER, True, 0, 1),
+    (STATE_LEADER, STATE_FOLLOWER, True, 1, NONE),
+    (STATE_LEADER, STATE_CANDIDATE, False, 1, NONE),
+    (STATE_LEADER, STATE_LEADER, True, 0, 1),
+]
+
+
+@pytest.mark.parametrize("from_,to,wallow,wterm,wlead",
+                         STATE_TRANSITION_CASES)
+def test_state_transition(from_, to, wallow, wterm, wlead):
+    sm = new_raft(1, [1])
+    sm.state = from_
+
+    def do():
+        if to == STATE_FOLLOWER:
+            sm.become_follower(wterm, wlead)
+        elif to == STATE_CANDIDATE:
+            sm.become_candidate()
+        else:
+            sm.become_leader()
+
+    if not wallow:
+        with pytest.raises(RaftPanicError):
+            do()
+        return
+    do()
+    assert sm.term == wterm
+    assert sm.lead == wlead
+
+
+@pytest.mark.parametrize("state,wstate,wterm,windex", [
+    (STATE_FOLLOWER, STATE_FOLLOWER, 3, 1),
+    (STATE_CANDIDATE, STATE_FOLLOWER, 3, 1),
+    (STATE_LEADER, STATE_FOLLOWER, 3, 2),
+])
+def test_all_server_stepdown(state, wstate, wterm, windex):
+    sm = new_raft(1, [1, 2, 3])
+    if state == STATE_FOLLOWER:
+        sm.become_follower(1, NONE)
+    elif state == STATE_CANDIDATE:
+        sm.become_candidate()
+    else:
+        sm.become_candidate()
+        sm.become_leader()
+
+    for msg_type in (MSG_VOTE, MSG_APP):
+        sm.step(msg(from_=2, type=msg_type, term=3, log_term=3))
+        assert sm.state == wstate
+        assert sm.term == wterm
+        assert len(sm.raft_log.ents) == windex
+        wlead = NONE if msg_type == MSG_VOTE else 2
+        assert sm.lead == wlead
+
+
+@pytest.mark.parametrize("index,reject,wmsgnum,windex,wcommitted", [
+    (3, True, 0, 0, 0),   # stale resp; no replies
+    (2, True, 1, 1, 0),   # denied; decrease next, probe
+    (2, False, 2, 2, 2),  # accept; commit; broadcast commit index
+])
+def test_leader_app_resp(index, reject, wmsgnum, windex, wcommitted):
+    sm = ents_preset(0, 1)
+    sm.id = 1
+    sm.prs = {i: Progress() for i in (1, 2, 3)}
+    sm.become_candidate()
+    sm.become_leader()
+    sm.read_messages()
+    sm.step(msg(from_=2, type=MSG_APP_RESP, index=index, term=sm.term,
+                reject=reject))
+    ms = sm.read_messages()
+    assert len(ms) == wmsgnum
+    for m in ms:
+        assert m.index == windex
+        assert m.commit == wcommitted
+
+
+def test_bcast_beat():
+    # leader heartbeats carry no entries even with a compacted log
+    # (raft_test.go:812-837)
+    offset = 1000
+    s = Snapshot(index=offset, term=1, nodes=[1, 2, 3])
+    sm = new_raft(1, [1, 2, 3])
+    sm.term = 1
+    sm.restore(s)
+    sm.become_candidate()
+    sm.become_leader()
+    for _ in range(10):
+        sm.append_entry(Entry())
+    sm.step(msg(type=MSG_BEAT))
+    ms = sm.read_messages()
+    assert len(ms) == 2
+    tos = {2, 3}
+    for m in ms:
+        assert m.type == MSG_APP
+        assert m.index == 0
+        assert m.log_term == 0
+        assert m.to in tos
+        tos.discard(m.to)
+        assert len(m.entries) == 0
+
+
+@pytest.mark.parametrize("state,wmsg", [
+    (STATE_LEADER, 2),
+    (STATE_CANDIDATE, 0),
+    (STATE_FOLLOWER, 0),
+])
+def test_recv_msg_beat(state, wmsg):
+    sm = ents_preset(0, 1)
+    sm.id = 1
+    sm.prs = {i: Progress() for i in (1, 2, 3)}
+    sm.term = 1
+    sm.state = state
+    sm._step = {STATE_FOLLOWER: _step_follower,
+                STATE_CANDIDATE: _step_candidate,
+                STATE_LEADER: _step_leader}[state]
+    sm.step(msg(from_=1, to=1, type=MSG_BEAT))
+    ms = sm.read_messages()
+    assert len(ms) == wmsg
+    assert all(m.type == MSG_APP for m in ms)
+
+
+# ---------------------------------------------------------------------------
+# snapshots (raft_test.go:897-1005)
+# ---------------------------------------------------------------------------
+
+def test_restore():
+    s = Snapshot(index=DEFAULT_COMPACT_THRESHOLD + 1,
+                 term=DEFAULT_COMPACT_THRESHOLD + 1,
+                 nodes=[1, 2, 3], removed_nodes=[4, 5])
+    sm = new_raft(1, [1, 2])
+    assert sm.restore(s)
+    assert sm.raft_log.last_index() == s.index
+    assert sm.raft_log.term(s.index) == s.term
+    assert sm.nodes() == s.nodes
+    assert sm.removed_nodes() == s.removed_nodes
+    assert sm.raft_log.snapshot == s
+    # second restore at same index is refused
+    assert not sm.restore(s)
+
+
+def test_provide_snap():
+    s = Snapshot(index=DEFAULT_COMPACT_THRESHOLD + 1,
+                 term=DEFAULT_COMPACT_THRESHOLD + 1, nodes=[1, 2])
+    sm = new_raft(1, [1])
+    sm.restore(s)
+    sm.become_candidate()
+    sm.become_leader()
+    sm.prs[2].next = sm.raft_log.offset
+    sm.step(msg(from_=2, to=1, type=MSG_APP_RESP, index=sm.prs[2].next - 1,
+                reject=True))
+    ms = sm.read_messages()
+    assert len(ms) == 1
+    assert ms[0].type == MSG_SNAP
+
+
+def test_restore_from_snap_msg():
+    s = Snapshot(index=DEFAULT_COMPACT_THRESHOLD + 1,
+                 term=DEFAULT_COMPACT_THRESHOLD + 1, nodes=[1, 2])
+    m = msg(type=MSG_SNAP, from_=1, term=2, snapshot=s)
+    sm = new_raft(2, [1, 2])
+    sm.step(m)
+    assert sm.raft_log.snapshot == s
+
+
+def test_slow_node_restore():
+    nt = Network(None, None, None)
+    nt.send(msg(from_=1, to=1, type=MSG_HUP))
+    nt.isolate(3)
+    for _ in range(DEFAULT_COMPACT_THRESHOLD + 1):
+        nt.send(msg(from_=1, to=1, type=MSG_PROP, entries=[Entry()]))
+    lead = nt.peers[1]
+    next_ents(lead)
+    lead.compact(lead.raft_log.applied, lead.nodes(), b"")
+    nt.recover()
+    nt.send(msg(from_=1, to=1, type=MSG_PROP, entries=[Entry()]))
+    follower = nt.peers[3]
+    assert follower.raft_log.snapshot == lead.raft_log.snapshot
+    nt.send(msg(from_=1, to=1, type=MSG_PROP, entries=[Entry()]))
+    assert follower.raft_log.committed == lead.raft_log.committed
+
+
+# ---------------------------------------------------------------------------
+# conf changes + membership (raft_test.go:1008-1146)
+# ---------------------------------------------------------------------------
+
+def test_step_config():
+    r = new_raft(1, [1, 2])
+    r.become_candidate()
+    r.become_leader()
+    index = r.raft_log.last_index()
+    r.step(msg(from_=1, to=1, type=MSG_PROP,
+               entries=[Entry(type=ENTRY_CONF_CHANGE)]))
+    assert r.raft_log.last_index() == index + 1
+    assert r.pending_conf
+
+
+def test_step_ignore_config():
+    r = new_raft(1, [1, 2])
+    r.become_candidate()
+    r.become_leader()
+    r.step(msg(from_=1, to=1, type=MSG_PROP,
+               entries=[Entry(type=ENTRY_CONF_CHANGE)]))
+    index = r.raft_log.last_index()
+    pending = r.pending_conf
+    r.step(msg(from_=1, to=1, type=MSG_PROP,
+               entries=[Entry(type=ENTRY_CONF_CHANGE)]))
+    assert r.raft_log.last_index() == index
+    assert r.pending_conf == pending
+
+
+@pytest.mark.parametrize("ent_type,wpending", [
+    (ENTRY_NORMAL, False),
+    (ENTRY_CONF_CHANGE, True),
+])
+def test_recover_pending_config(ent_type, wpending):
+    r = new_raft(1, [1, 2])
+    r.append_entry(Entry(type=ent_type))
+    r.become_candidate()
+    r.become_leader()
+    assert r.pending_conf == wpending
+
+
+def test_recover_double_pending_config():
+    r = new_raft(1, [1, 2])
+    r.append_entry(Entry(type=ENTRY_CONF_CHANGE))
+    r.append_entry(Entry(type=ENTRY_CONF_CHANGE))
+    r.become_candidate()
+    with pytest.raises(RaftPanicError):
+        r.become_leader()
+
+
+def test_add_node():
+    r = new_raft(1, [1])
+    r.pending_conf = True
+    r.add_node(2)
+    assert not r.pending_conf
+    assert r.nodes() == [1, 2]
+
+
+def test_remove_node():
+    r = new_raft(1, [1, 2])
+    r.pending_conf = True
+    r.remove_node(2)
+    assert not r.pending_conf
+    assert r.nodes() == [1]
+    assert r.removed == {2: True}
+
+
+def test_recv_msg_denied():
+    called = []
+    r = new_raft(1, [1, 2])
+    r._step = lambda rr, m: called.append(m)
+    r.step(msg(from_=2, type=MSG_DENIED))
+    assert not called
+    assert r.removed == {1: True}
+
+
+@pytest.mark.parametrize("from_,wmsgnum", [(1, 0), (2, 1)])
+def test_recv_msg_from_removed_node(from_, wmsgnum):
+    called = []
+    r = new_raft(1, [1])
+    r._step = lambda rr, m: called.append(m)
+    r.remove_node(from_)
+    r.step(msg(from_=from_, type=MSG_VOTE))
+    assert not called
+    assert len(r.msgs) == wmsgnum
+    assert all(m.type == MSG_DENIED for m in r.msgs)
+
+
+@pytest.mark.parametrize("peers,wp", [
+    ([1], True),
+    ([1, 2, 3], True),
+    ([], False),
+    ([2, 3], False),
+])
+def test_promotable(peers, wp):
+    r = Raft.__new__(Raft)
+    r.id = 1
+    r.prs = {p: Progress() for p in peers}
+    assert r.promotable() == wp
+
+
+def test_conf_change_recovery_via_network():
+    # a cluster where node 3 is added at runtime then participates in
+    # commit (pattern of raft_test.go:1046+)
+    nt = Network(None, None)
+    nt.send(msg(from_=1, to=1, type=MSG_HUP))
+    lead = nt.peers[1]
+    # propose conf change to add node 3
+    nt.send(msg(from_=1, to=1, type=MSG_PROP,
+                entries=[Entry(type=ENTRY_CONF_CHANGE, data=b"add3")]))
+    assert lead.pending_conf
+    # apply it on both current members
+    lead.add_node(3)
+    nt.peers[2].add_node(3)
+    assert not lead.pending_conf
+    # wire in the new member and let replication catch it up
+    sm3 = new_raft(3, [1, 2, 3])
+    nt.peers[3] = sm3
+    nt.send(msg(from_=1, to=1, type=MSG_PROP, entries=[Entry(data=b"x")]))
+    assert sm3.raft_log.committed == lead.raft_log.committed
