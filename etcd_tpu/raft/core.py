@@ -18,7 +18,6 @@ from __future__ import annotations
 import random
 
 from ..wire import (
-    CONF_CHANGE_ADD_NODE,
     ENTRY_CONF_CHANGE,
     Entry,
     HardState,

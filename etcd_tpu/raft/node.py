@@ -24,7 +24,6 @@ from ..wire import (
     CONF_CHANGE_REMOVE_NODE,
     ConfChange,
     ENTRY_CONF_CHANGE,
-    EMPTY_HARD_STATE,
     Entry,
     HardState,
     MSG_BEAT,
@@ -217,9 +216,21 @@ class Node:
             rd.snapshot = r.raft_log.snapshot
         return rd
 
+    def _has_updates(self) -> bool:
+        """Cheap containsUpdates check — no list materialization; the
+        predicate runs on every condition wakeup."""
+        r = self.r
+        log = r.raft_log
+        return (bool(r.msgs)
+                or log.unstable <= log.last_index()
+                or log.committed > log.applied
+                or r.soft_state() != self._prev_soft
+                or r.hard_state() != self._prev_hard
+                or log.snapshot.index != self._prev_snapi)
+
     def has_ready(self) -> bool:
         with self._lock:
-            return self._new_ready().contains_updates()
+            return self._has_updates()
 
     def ready(self, timeout: float | None = None) -> Ready | None:
         """Block until the SM has updates; consuming the Ready performs
@@ -227,8 +238,7 @@ class Node:
         Returns None on stop or timeout."""
         with self._cond:
             ok = self._cond.wait_for(
-                lambda: self._stopped
-                or self._new_ready().contains_updates(),
+                lambda: self._stopped or self._has_updates(),
                 timeout=timeout)
             if self._stopped or not ok:
                 return None

@@ -1,0 +1,178 @@
+"""Watchers and the watcher hub (reference store/watcher.go,
+store/watcher_hub.go).
+
+The reference's buffered channel becomes a bounded queue: notification
+is non-blocking, and a watcher whose queue overflows is evicted (slow
+watcher eviction, watcher.go:61-72) — delivery never stalls the store.
+"""
+
+from __future__ import annotations
+
+import posixpath
+import queue
+import threading
+
+from ..utils.errors import EtcdError
+from .event import Event
+from .event_history import EventHistory
+
+_CLOSED = object()  # sentinel marking a closed event channel
+
+
+class Watcher:
+    """One registered watch (reference store/watcher.go:26-90)."""
+
+    def __init__(self, hub: "WatcherHub", recursive: bool, stream: bool,
+                 since_index: int, start_index: int):
+        self.event_queue: queue.Queue = queue.Queue(maxsize=100)
+        self.recursive = recursive
+        self.stream = stream
+        self.since_index = since_index
+        self.start_index = start_index
+        self.hub = hub
+        self.removed = False
+        self._remove_cb = None
+
+    def start_index_(self) -> int:
+        return self.start_index
+
+    def next_event(self, timeout: float | None = None) -> Event | None:
+        """Block for the next event; None when the watcher was removed
+        (channel closed) or the timeout expired."""
+        try:
+            item = self.event_queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        if item is _CLOSED:
+            return None
+        return item
+
+    def notify(self, e: Event, original_path: bool, deleted: bool) -> bool:
+        """Non-blocking send; overflow evicts the watcher
+        (reference watcher.go:46-79)."""
+        if (self.recursive or original_path or deleted) \
+                and e.index() >= self.since_index:
+            try:
+                self.event_queue.put_nowait(e)
+            except queue.Full:
+                # missed a notification: remove (and thereby close)
+                if self._remove_cb:
+                    self._remove_cb()
+                self._close()
+            return True
+        return False
+
+    def remove(self) -> None:
+        """Public removal; idempotent (watcher.go:84-90)."""
+        with self.hub.mutex:
+            self._close()
+            if self._remove_cb:
+                self._remove_cb()
+
+    def _close(self) -> None:
+        """The sentinel must always land so a draining consumer
+        observes closure (a closed Go channel stays readable); on a
+        full queue we sacrifice one buffered event for it."""
+        try:
+            self.event_queue.put_nowait(_CLOSED)
+        except queue.Full:
+            try:
+                self.event_queue.get_nowait()
+            except queue.Empty:
+                pass
+            try:
+                self.event_queue.put_nowait(_CLOSED)
+            except queue.Full:  # pragma: no cover
+                pass
+
+
+class WatcherHub:
+    """Per-path watcher lists with ancestor fan-out
+    (reference store/watcher_hub.go:19-160)."""
+
+    def __init__(self, capacity: int):
+        self.mutex = threading.RLock()
+        self.watchers: dict[str, list[Watcher]] = {}
+        self.count = 0
+        self.event_history = EventHistory(capacity)
+
+    def watch(self, key: str, recursive: bool, stream: bool, index: int,
+              store_index: int) -> Watcher:
+        """Register a watch, serving from history if possible
+        (watcher_hub.go:41-97)."""
+        event = self.event_history.scan(key, recursive, index)
+
+        w = Watcher(self, recursive, stream, index, store_index)
+
+        if event is not None:
+            event.etcd_index = store_index
+            w.event_queue.put_nowait(event)
+            return w
+
+        with self.mutex:
+            lst = self.watchers.setdefault(key, [])
+            lst.append(w)
+
+            def remove():
+                if w.removed:
+                    return
+                w.removed = True
+                try:
+                    lst.remove(w)
+                except ValueError:
+                    pass
+                self.count -= 1
+                if not lst and self.watchers.get(key) is lst:
+                    del self.watchers[key]
+
+            w._remove_cb = remove
+            self.count += 1
+        return w
+
+    def notify(self, e: Event) -> None:
+        """Ancestor-path fan-out: an event at /foo/bar notifies
+        watchers at /, /foo, and /foo/bar (watcher_hub.go:99-115)."""
+        e = self.event_history.add_event(e)
+        segments = e.node.key.split("/")
+        curr_path = "/"
+        for segment in segments:
+            curr_path = posixpath.join(curr_path, segment)
+            self.notify_watchers(e, curr_path, False)
+
+    def notify_watchers(self, e: Event, node_path: str,
+                        deleted: bool) -> None:
+        with self.mutex:
+            lst = self.watchers.get(node_path)
+            if not lst:
+                return
+            for w in list(lst):
+                original_path = e.node.key == node_path
+                if (original_path
+                        or not is_hidden(node_path, e.node.key)) \
+                        and w.notify(e, original_path, deleted):
+                    if not w.stream:
+                        # one-shot watcher: fires once then removed
+                        if not w.removed:
+                            w.removed = True
+                            try:
+                                lst.remove(w)
+                            except ValueError:
+                                pass
+                            self.count -= 1
+                        w._close()
+            if not lst and self.watchers.get(node_path) is lst:
+                del self.watchers[node_path]
+
+    def clone(self) -> "WatcherHub":
+        c = WatcherHub(self.event_history.queue.capacity)
+        c.event_history = self.event_history.clone()
+        return c
+
+
+def is_hidden(watch_path: str, key_path: str) -> bool:
+    """Whether key_path is hidden relative to watch_path
+    (reference watcher_hub.go:147-157)."""
+    if len(watch_path) > len(key_path):
+        return False
+    after_path = posixpath.normpath("/" + key_path[len(watch_path):])
+    return "/_" in after_path

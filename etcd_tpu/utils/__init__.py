@@ -1,0 +1,2 @@
+"""L1 utilities: error vocabulary, typed flags, URL types, CORS,
+request/response correlation (reference pkg/ + error/ + wait/)."""

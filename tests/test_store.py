@@ -1,0 +1,452 @@
+"""Store tests (reference store/store_test.go matrix: CRUD, CAS/CAD,
+TTL expiry, hidden nodes, watches, save/recovery)."""
+
+import time
+
+import pytest
+
+from etcd_tpu.store import PERMANENT, Store
+from etcd_tpu.utils.errors import (
+    ECODE_DIR_NOT_EMPTY,
+    ECODE_EVENT_INDEX_CLEARED,
+    ECODE_KEY_NOT_FOUND,
+    ECODE_NODE_EXIST,
+    ECODE_NOT_FILE,
+    ECODE_ROOT_RONLY,
+    ECODE_TEST_FAILED,
+    EtcdError,
+)
+
+
+def err_code(excinfo):
+    return excinfo.value.error_code
+
+
+def test_create_and_get():
+    s = Store()
+    e = s.create("/foo", False, "bar", False, PERMANENT)
+    assert e.action == "create"
+    assert e.node.key == "/foo"
+    assert e.node.value == "bar"
+    assert e.node.modified_index == 1 and e.node.created_index == 1
+
+    g = s.get("/foo", False, False)
+    assert g.action == "get"
+    assert g.node.value == "bar"
+    assert g.etcd_index == 1
+
+
+def test_create_intermediate_dirs():
+    s = Store()
+    s.create("/a/b/c", False, "v", False, PERMANENT)
+    g = s.get("/a/b", False, False)
+    assert g.node.dir
+    g = s.get("/a/b/c", False, False)
+    assert g.node.value == "v"
+
+
+def test_create_existing_fails():
+    s = Store()
+    s.create("/foo", False, "bar", False, PERMANENT)
+    with pytest.raises(EtcdError) as ei:
+        s.create("/foo", False, "again", False, PERMANENT)
+    assert err_code(ei) == ECODE_NODE_EXIST
+
+
+def test_create_under_file_fails():
+    s = Store()
+    s.create("/foo", False, "bar", False, PERMANENT)
+    with pytest.raises(EtcdError):
+        s.create("/foo/sub", False, "x", False, PERMANENT)
+
+
+def test_root_read_only():
+    s = Store()
+    for fn in (lambda: s.set("/", False, "x", PERMANENT),
+               lambda: s.update("/", "x", PERMANENT),
+               lambda: s.delete("/", True, True),
+               lambda: s.compare_and_swap("/", "", 0, "x", PERMANENT)):
+        with pytest.raises(EtcdError) as ei:
+            fn()
+        assert err_code(ei) == ECODE_ROOT_RONLY
+
+
+def test_set_replaces_and_reports_prev():
+    s = Store()
+    s.create("/foo", False, "bar", False, PERMANENT)
+    e = s.set("/foo", False, "baz", PERMANENT)
+    assert e.action == "set"
+    assert e.prev_node.value == "bar"
+    assert e.node.value == "baz"
+    assert e.node.modified_index == 2
+    assert not e.is_created()
+
+
+def test_set_new_is_created():
+    s = Store()
+    e = s.set("/new", False, "v", PERMANENT)
+    assert e.is_created()
+
+
+def test_unique_create_in_order():
+    # POST semantics: unique appends index-named children
+    # (store.go:456-458)
+    s = Store()
+    e1 = s.create("/queue", True, "", False, PERMANENT)
+    a = s.create("/queue", False, "job1", True, PERMANENT)
+    b = s.create("/queue", False, "job2", True, PERMANENT)
+    assert a.node.key == "/queue/2"
+    assert b.node.key == "/queue/3"
+    g = s.get("/queue", True, True)
+    assert [n.key for n in g.node.nodes] == ["/queue/2", "/queue/3"]
+
+
+def test_update_value_and_dir():
+    s = Store()
+    s.create("/foo", False, "bar", False, PERMANENT)
+    e = s.update("/foo", "baz", PERMANENT)
+    assert e.action == "update"
+    assert e.node.value == "baz"
+    assert e.prev_node.value == "bar"
+
+    s.create("/dir", True, "", False, PERMANENT)
+    with pytest.raises(EtcdError) as ei:
+        s.update("/dir", "nonempty", PERMANENT)
+    assert err_code(ei) == ECODE_NOT_FILE
+    # empty value updates dir ttl fine
+    e = s.update("/dir", "", time.time() + 100)
+    assert e.node.dir
+
+
+def test_compare_and_swap():
+    s = Store()
+    s.create("/foo", False, "bar", False, PERMANENT)
+    # value match
+    e = s.compare_and_swap("/foo", "bar", 0, "baz", PERMANENT)
+    assert e.node.value == "baz"
+    # index match
+    e = s.compare_and_swap("/foo", "", e.node.modified_index, "qux",
+                           PERMANENT)
+    assert e.node.value == "qux"
+    # mismatch
+    with pytest.raises(EtcdError) as ei:
+        s.compare_and_swap("/foo", "wrong", 0, "x", PERMANENT)
+    assert err_code(ei) == ECODE_TEST_FAILED
+    with pytest.raises(EtcdError) as ei:
+        s.compare_and_swap("/foo", "", 12345, "x", PERMANENT)
+    assert err_code(ei) == ECODE_TEST_FAILED
+
+
+def test_cas_on_dir_fails():
+    s = Store()
+    s.create("/dir", True, "", False, PERMANENT)
+    with pytest.raises(EtcdError) as ei:
+        s.compare_and_swap("/dir", "", 0, "x", PERMANENT)
+    assert err_code(ei) == ECODE_NOT_FILE
+
+
+def test_delete_file_and_dir():
+    s = Store()
+    s.create("/foo", False, "bar", False, PERMANENT)
+    e = s.delete("/foo", False, False)
+    assert e.action == "delete"
+    assert e.prev_node.value == "bar"
+    with pytest.raises(EtcdError) as ei:
+        s.get("/foo", False, False)
+    assert err_code(ei) == ECODE_KEY_NOT_FOUND
+
+    s.create("/dir/sub", False, "x", False, PERMANENT)
+    # plain delete of a dir fails
+    with pytest.raises(EtcdError) as ei:
+        s.delete("/dir", False, False)
+    assert err_code(ei) == ECODE_NOT_FILE
+    # dir delete of non-empty dir fails without recursive
+    with pytest.raises(EtcdError) as ei:
+        s.delete("/dir", True, False)
+    assert err_code(ei) == ECODE_DIR_NOT_EMPTY
+    # recursive works
+    e = s.delete("/dir", False, True)
+    assert e.node.dir
+
+
+def test_compare_and_delete():
+    s = Store()
+    s.create("/foo", False, "bar", False, PERMANENT)
+    with pytest.raises(EtcdError) as ei:
+        s.compare_and_delete("/foo", "wrong", 0)
+    assert err_code(ei) == ECODE_TEST_FAILED
+    e = s.compare_and_delete("/foo", "bar", 0)
+    assert e.action == "compareAndDelete"
+    with pytest.raises(EtcdError):
+        s.get("/foo", False, False)
+
+
+def test_hidden_nodes_not_listed():
+    s = Store()
+    s.create("/foo/_hidden", False, "secret", False, PERMANENT)
+    s.create("/foo/visible", False, "open", False, PERMANENT)
+    g = s.get("/foo", True, True)
+    assert [n.key for n in g.node.nodes] == ["/foo/visible"]
+    # but directly gettable
+    assert s.get("/foo/_hidden", False, False).node.value == "secret"
+
+
+def test_index_advances_only_on_mutation():
+    s = Store()
+    assert s.index() == 0
+    s.create("/a", False, "1", False, PERMANENT)
+    assert s.index() == 1
+    s.get("/a", False, False)
+    assert s.index() == 1
+    s.set("/a", False, "2", PERMANENT)
+    assert s.index() == 2
+
+
+# -- TTL ---------------------------------------------------------------------
+
+def test_ttl_expiry():
+    s = Store()
+    now = time.time()
+    s.create("/expiring", False, "v", False, now + 0.5)
+    s.create("/keeper", False, "v", False, PERMANENT)
+    s.delete_expired_keys(now)  # not yet
+    assert s.get("/expiring", False, False).node.value == "v"
+    s.delete_expired_keys(now + 1)
+    with pytest.raises(EtcdError):
+        s.get("/expiring", False, False)
+    assert s.get("/keeper", False, False).node.value == "v"
+    assert s.stats.expire_count == 1
+
+
+def test_ttl_ordering_in_heap():
+    s = Store()
+    now = time.time()
+    s.create("/c", False, "", False, now + 3)
+    s.create("/a", False, "", False, now + 1)
+    s.create("/b", False, "", False, now + 2)
+    s.delete_expired_keys(now + 1.5)
+    with pytest.raises(EtcdError):
+        s.get("/a", False, False)
+    s.get("/b", False, False)
+    s.get("/c", False, False)
+
+
+def test_update_ttl_to_permanent():
+    s = Store()
+    now = time.time()
+    s.create("/foo", False, "v", False, now + 0.5)
+    s.update("/foo", "v", PERMANENT)
+    s.delete_expired_keys(now + 10)
+    assert s.get("/foo", False, False).node.value == "v"
+
+
+def test_ancient_expire_time_means_permanent():
+    # expire times before 2000-01-01 are treated as permanent
+    # (store.go:467-471)
+    s = Store()
+    s.create("/foo", False, "v", False, 1.0)
+    s.delete_expired_keys(time.time() + 10)
+    assert s.get("/foo", False, False).node.value == "v"
+
+
+def test_ttl_reported():
+    s = Store()
+    e = s.create("/foo", False, "v", False, time.time() + 100)
+    assert 99 <= e.node.ttl <= 101
+    assert e.node.expiration is not None
+
+
+# -- watches -----------------------------------------------------------------
+
+def test_watch_oneshot_fires_on_set():
+    s = Store()
+    w = s.watch("/foo", False, False, 0)
+    s.set("/foo", False, "bar", PERMANENT)
+    e = w.next_event(timeout=1)
+    assert e.action == "set"
+    assert e.node.key == "/foo"
+    # oneshot: no second event
+    s.set("/foo", False, "baz", PERMANENT)
+    assert w.next_event(timeout=0.05) is None
+
+
+def test_watch_recursive():
+    s = Store()
+    w = s.watch("/dir", True, False, 0)
+    s.set("/dir/sub/key", False, "v", PERMANENT)
+    e = w.next_event(timeout=1)
+    assert e.node.key == "/dir/sub/key"
+
+
+def test_watch_nonrecursive_ignores_children():
+    s = Store()
+    w = s.watch("/dir", False, False, 0)
+    s.set("/dir/sub", False, "v", PERMANENT)
+    assert w.next_event(timeout=0.05) is None
+
+
+def test_watch_history_catchup():
+    s = Store()
+    s.set("/foo", False, "v1", PERMANENT)  # index 1
+    s.set("/foo", False, "v2", PERMANENT)  # index 2
+    w = s.watch("/foo", False, False, 1)
+    e = w.next_event(timeout=1)
+    assert e.node.modified_index == 1
+    w = s.watch("/foo", False, False, 2)
+    e = w.next_event(timeout=1)
+    assert e.node.modified_index == 2
+
+
+def test_watch_history_cleared_error():
+    s = Store(history_capacity=2)
+    for i in range(5):
+        s.set("/k%d" % i, False, "v", PERMANENT)
+    with pytest.raises(EtcdError) as ei:
+        s.watch("/k0", False, False, 1)
+    assert err_code(ei) == ECODE_EVENT_INDEX_CLEARED
+
+
+def test_watch_stream_gets_multiple():
+    s = Store()
+    w = s.watch("/foo", False, True, 0)
+    s.set("/foo", False, "1", PERMANENT)
+    s.set("/foo", False, "2", PERMANENT)
+    assert w.next_event(timeout=1).node.value == "1"
+    assert w.next_event(timeout=1).node.value == "2"
+
+
+def test_watch_delete_of_parent_notifies_child_watcher():
+    s = Store()
+    s.set("/foo/bar", False, "v", PERMANENT)
+    w = s.watch("/foo/bar", False, False, 0)
+    s.delete("/foo", False, True)
+    e = w.next_event(timeout=1)
+    assert e.action == "delete"
+
+
+def test_watch_expire_notifies():
+    s = Store()
+    now = time.time()
+    s.create("/gone", False, "v", False, now + 0.2)
+    w = s.watch("/gone", False, False, 0)
+    s.delete_expired_keys(now + 1)
+    e = w.next_event(timeout=1)
+    assert e.action == "expire"
+
+
+def test_hidden_node_events_not_fanned_out():
+    # a watcher on /foo does not hear about /foo/_hidden changes
+    # (watcher_hub.go:131,147-157)
+    s = Store()
+    w = s.watch("/foo", True, False, 0)
+    s.set("/foo/_hidden", False, "v", PERMANENT)
+    assert w.next_event(timeout=0.05) is None
+
+
+def test_slow_stream_watcher_evicted():
+    s = Store()
+    w = s.watch("/k", False, True, 0)
+    for i in range(150):  # overflow the 100-slot buffer
+        s.set("/k", False, str(i), PERMANENT)
+    # drain; the channel was closed after eviction
+    seen = 0
+    while True:
+        e = w.next_event(timeout=0.05)
+        if e is None:
+            break
+        seen += 1
+    assert seen <= 101
+    assert s.watcher_hub.count == 0
+
+
+def test_watcher_remove():
+    s = Store()
+    w = s.watch("/k", False, False, 0)
+    assert s.watcher_hub.count == 1
+    w.remove()
+    assert s.watcher_hub.count == 0
+    # removal is idempotent
+    w.remove()
+    assert s.watcher_hub.count == 0
+
+
+# -- save/recovery -----------------------------------------------------------
+
+def test_save_and_recovery_roundtrip():
+    s = Store()
+    s.set("/foo", False, "bar", PERMANENT)
+    s.set("/dir/sub", False, "x", PERMANENT)
+    s.create("/ttlkey", False, "v", False, time.time() + 100)
+    blob = s.save()
+
+    s2 = Store()
+    s2.recovery(blob)
+    assert s2.get("/foo", False, False).node.value == "bar"
+    assert s2.get("/dir/sub", False, False).node.value == "x"
+    assert s2.index() == s.index()
+    # ttl survived and the heap was rebuilt
+    assert len(s2.ttl_key_heap) == 1
+    s2.delete_expired_keys(time.time() + 200)
+    with pytest.raises(EtcdError):
+        s2.get("/ttlkey", False, False)
+
+
+def test_recovery_expired_key_cleanup():
+    s = Store()
+    s.create("/dead", False, "v", False, time.time() + 0.05)
+    blob = s.save()
+    time.sleep(0.1)
+    s2 = Store()
+    s2.recovery(blob)
+    s2.delete_expired_keys(time.time())
+    with pytest.raises(EtcdError):
+        s2.get("/dead", False, False)
+
+
+def test_recovery_restores_stats_and_event_history():
+    s = Store()
+    s.set("/foo", False, "v1", PERMANENT)  # index 1
+    s.set("/foo", False, "v2", PERMANENT)  # index 2
+    blob = s.save()
+
+    s2 = Store()
+    s2.recovery(blob)
+    # stats restored
+    assert s2.stats.set_success == 2
+    # history restored: a watch at a past index replays from history
+    w = s2.watch("/foo", False, False, 2)
+    e = w.next_event(timeout=1)
+    assert e is not None and e.node.modified_index == 2
+
+
+def test_evicted_watcher_consumer_observes_closure():
+    # the close sentinel must land even on a full queue, so a consumer
+    # draining an evicted watcher terminates
+    s = Store()
+    w = s.watch("/k", False, True, 0)
+    for i in range(150):
+        s.set("/k", False, str(i), PERMANENT)
+    drained = 0
+    while True:
+        e = w.next_event(timeout=0.2)
+        if e is None:
+            break
+        drained += 1
+    assert drained <= 100  # one slot was sacrificed for the sentinel
+
+
+def test_json_stats():
+    import json
+
+    s = Store()
+    s.set("/a", False, "1", PERMANENT)
+    s.get("/a", False, False)
+    try:
+        s.get("/missing", False, False)
+    except EtcdError:
+        pass
+    d = json.loads(s.json_stats())
+    assert d["setsSuccess"] == 1
+    assert d["getsSuccess"] == 1
+    assert d["getsFail"] == 1
+    assert s.total_transactions() == 1
