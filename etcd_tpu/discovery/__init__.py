@@ -1,0 +1,6 @@
+"""L5 cluster bootstrap via a public etcd discovery URL
+(reference discovery/)."""
+
+from .discovery import Discoverer, DiscoveryError
+
+__all__ = ["Discoverer", "DiscoveryError"]

@@ -1,0 +1,101 @@
+"""Discovery bootstrap client (reference discovery/discovery.go).
+
+Register self under the discovery prefix, read _config/size, then
+watch until ``size`` peers have registered; emits the initial-cluster
+string (discovery.go:213-227).  Retries use exponential backoff capped
+at ``MAX_RETRY`` rounds (discovery.go:28-31,161-175).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import urllib.parse
+
+log = logging.getLogger(__name__)
+
+MAX_RETRY = 3
+# injectable for tests (reference discovery.go:46-47)
+TIMEOUT_TIMESCALE = 1.0
+
+
+class DiscoveryError(Exception):
+    pass
+
+
+class Discoverer:
+    def __init__(self, durl: str, id: int, config: str, client=None):
+        """``client`` implements create/get/watch against the discovery
+        service; defaults to the bundled etcd client."""
+        u = urllib.parse.urlsplit(durl)
+        self.url = durl
+        self.cluster = u.path.strip("/")
+        self.id = id
+        self.config = config
+        if client is None:
+            from ..api.client import Client
+
+            base = urllib.parse.urlunsplit(
+                (u.scheme, u.netloc, "", "", ""))
+            client = Client([base])
+        self.client = client
+
+    def discover(self) -> str:
+        """Reference discovery.go:55-99."""
+        # 1. register self
+        self._create_self()
+        # 2. wait for enough peers
+        nodes, size, index = self._check_cluster()
+        all_nodes = self._wait_nodes(nodes, size, index)
+        return nodes_to_cluster(all_nodes)
+
+    def _create_self(self) -> None:
+        """Reference discovery.go:101-111."""
+        key = f"/{self.cluster}/{self.id:x}"
+        self.client.create(key, self.config)
+
+    def _check_cluster(self):
+        """Read registered nodes + expected size
+        (reference discovery.go:113-159)."""
+        retry = 0
+        while True:
+            try:
+                resp = self.client.get(f"/{self.cluster}/_config/size")
+                size = int(resp["node"]["value"])
+                resp = self.client.get(f"/{self.cluster}", recursive=False,
+                                       sorted=True)
+                nodes = [n for n in resp["node"].get("nodes", [])
+                         if not n["key"].rsplit("/", 1)[-1].startswith("_")]
+                nodes.sort(key=lambda n: n.get("createdIndex", 0))
+                index = resp.get("etcdIndex", 0)
+                return nodes[:size], size, index
+            except Exception as e:
+                retry += 1
+                if retry > MAX_RETRY:
+                    raise DiscoveryError(f"too many retries: {e}") from e
+                wait = (2 ** retry) * TIMEOUT_TIMESCALE
+                log.info("discovery: error %s, retrying in %.1fs", e, wait)
+                time.sleep(wait)
+
+    def _wait_nodes(self, nodes, size, index):
+        """Watch until size peers registered
+        (reference discovery.go:161-207)."""
+        all_nodes = list(nodes)
+        watch_index = index
+        while len(all_nodes) < size:
+            resp = self.client.watch(f"/{self.cluster}",
+                                     wait_index=watch_index + 1,
+                                     recursive=True)
+            node = resp["node"]
+            name = node["key"].rsplit("/", 1)[-1]
+            watch_index = node.get("modifiedIndex", watch_index + 1)
+            if name.startswith("_"):
+                continue
+            if not any(n["key"] == node["key"] for n in all_nodes):
+                all_nodes.append(node)
+        return all_nodes[:size]
+
+
+def nodes_to_cluster(nodes) -> str:
+    """Reference discovery.go:213-218."""
+    return ",".join(n["value"] for n in nodes)

@@ -1,0 +1,40 @@
+"""L5 server orchestration (reference etcdserver/)."""
+
+from .cluster import (
+    Cluster,
+    ClusterStore,
+    Member,
+    new_member,
+    parse_member_id,
+)
+from .config import CLUSTER_STATE_NEW, ServerConfig
+from .sender import new_sender
+from .server import (
+    DEFAULT_SNAP_COUNT,
+    EtcdServer,
+    Response,
+    ServerStoppedError,
+    UnknownMethodError,
+    WalSnapStorage,
+    gen_id,
+    new_server,
+)
+
+__all__ = [
+    "EtcdServer",
+    "Response",
+    "ServerConfig",
+    "ServerStoppedError",
+    "UnknownMethodError",
+    "WalSnapStorage",
+    "Cluster",
+    "ClusterStore",
+    "Member",
+    "new_member",
+    "new_sender",
+    "new_server",
+    "parse_member_id",
+    "gen_id",
+    "DEFAULT_SNAP_COUNT",
+    "CLUSTER_STATE_NEW",
+]

@@ -1,0 +1,64 @@
+"""Peer transport: fire-and-forget message sender
+(reference etcdserver/cluster_store.go:106-156).
+
+The reference's entire distributed communication backend: POST the
+marshaled raftpb.Message to http://<peer>/raft, one goroutine per
+message, three attempts with a fresh address pick each try, drops
+allowed by contract (server.go:202-206) — safety rests on raft, not
+delivery.  Here: one daemon thread per message batch.  ``post_fn`` is
+injectable so in-process cluster tests can short-circuit the network
+(the reference does the same by swapping sendFunc,
+server_test.go:378-384).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import urllib.error
+import urllib.request
+from typing import Callable
+
+from ..wire import Message
+from .cluster import RAFT_PREFIX, ClusterStore
+
+log = logging.getLogger(__name__)
+
+
+def default_post(url: str, data: bytes, timeout: float = 1.0) -> bool:
+    req = urllib.request.Request(
+        url, data=data, method="POST",
+        headers={"Content-Type": "application/protobuf"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status == 204
+    except (urllib.error.URLError, OSError):
+        return False
+
+
+def new_sender(cluster_store: ClusterStore,
+               post_fn: Callable[[str, bytes], bool] | None = None):
+    """Returns send(msgs) that MUST NOT block (server.go:202-206)."""
+    post = post_fn or default_post
+
+    def send(msgs: list[Message]) -> None:
+        for m in msgs:
+            t = threading.Thread(target=_send_one,
+                                 args=(cluster_store, m, post),
+                                 daemon=True)
+            t.start()
+
+    return send
+
+
+def _send_one(cls: ClusterStore, m: Message, post) -> None:
+    """Three attempts, address re-picked per try
+    (cluster_store.go:118-144)."""
+    data = m.marshal()
+    for _ in range(3):
+        u = cls.get().pick(m.to)
+        if not u:
+            log.warning("etcdhttp: no addr for %x", m.to)
+            return
+        if post(u + RAFT_PREFIX, data):
+            return

@@ -1,0 +1,464 @@
+"""Server orchestration: the hub tying Node+WAL+snap+store+sender
+together (reference etcdserver/server.go).
+
+One apply-loop thread runs the reference's ``run()`` select loop
+(server.go:247-323): tick the raft clock, pull Ready batches, persist
+HardState+entries BEFORE sending messages (the durability contract),
+apply committed entries to the store, trigger waiting clients, fire
+snapshots every ``snap_count`` applies, and propose leader SYNCs that
+expire TTL keys deterministically cluster-wide.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..raft import Node, Peer, STATE_LEADER, restart_node, start_node
+from ..snap import NoSnapshotError, Snapshotter
+from ..store import Store, Watcher
+from ..utils.errors import EtcdError
+from ..utils.wait import Wait
+from ..wal import WAL, exist as wal_exist
+from ..wire import (
+    CONF_CHANGE_ADD_NODE,
+    CONF_CHANGE_REMOVE_NODE,
+    ConfChange,
+    ENTRY_CONF_CHANGE,
+    ENTRY_NORMAL,
+    HardState,
+    Message,
+    Snapshot,
+    is_empty_snap,
+)
+from ..wire.requests import Info, Request
+from .cluster import ATTRIBUTES_SUFFIX, Cluster, ClusterStore, Member
+from .config import ServerConfig
+from .sender import new_sender
+
+log = logging.getLogger(__name__)
+
+DEFAULT_SYNC_TIMEOUT = 1.0
+DEFAULT_SNAP_COUNT = 10000  # reference server.go:29
+DEFAULT_PUBLISH_RETRY_INTERVAL = 5.0
+
+TICK_INTERVAL = 0.1       # reference server.go:182
+SYNC_INTERVAL = 0.5       # reference server.go:183
+ELECTION_TICKS = 10       # reference server.go:136,168
+HEARTBEAT_TICKS = 1
+
+
+class UnknownMethodError(Exception):
+    pass
+
+
+class ServerStoppedError(Exception):
+    pass
+
+
+def gen_id() -> int:
+    """Random nonzero 63-bit id (reference server.go:575-580)."""
+    n = 0
+    while n == 0:
+        n = random.getrandbits(63)
+    return n
+
+
+@dataclass
+class Response:
+    """Reference server.go:45-49."""
+
+    event: object | None = None
+    watcher: Optional[Watcher] = None
+    err: Exception | None = None
+
+
+class WalSnapStorage:
+    """The Storage seam (reference server.go:51-62): WAL + snapshotter
+    behind one interface so the device-backed replay path can swap in."""
+
+    def __init__(self, wal: WAL, snapshotter: Snapshotter):
+        self.wal = wal
+        self.snapshotter = snapshotter
+
+    def save(self, st: HardState, ents) -> None:
+        """MUST block until st and ents are on stable storage."""
+        self.wal.save(st, ents)
+
+    def save_snap(self, snap: Snapshot) -> None:
+        self.snapshotter.save_snap(snap)
+
+    def cut(self) -> None:
+        self.wal.cut()
+
+
+class EtcdServer:
+    """Reference server.go:191-218."""
+
+    def __init__(self, *, store: Store, node: Node, id: int,
+                 attributes: dict, storage, send: Callable,
+                 cluster_store: ClusterStore,
+                 snap_count: int = DEFAULT_SNAP_COUNT,
+                 tick_interval: float = TICK_INTERVAL,
+                 sync_interval: float = SYNC_INTERVAL):
+        self.store = store
+        self.node = node
+        self.id = id
+        self.attributes = attributes
+        self.storage = storage
+        self.send = send
+        self.cluster_store = cluster_store
+        self.snap_count = snap_count or DEFAULT_SNAP_COUNT
+        self.tick_interval = tick_interval
+        self.sync_interval = sync_interval
+
+        self.w = Wait()
+        self.done = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._publish_thread: threading.Thread | None = None
+        self.raft_index = 0
+        self.raft_term = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Reference server.go:223-241."""
+        self._start()
+        self._publish_thread = threading.Thread(
+            target=self.publish, args=(DEFAULT_PUBLISH_RETRY_INTERVAL,),
+            daemon=True)
+        self._publish_thread.start()
+
+    def _start(self) -> None:
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.node.stop()
+        self.done.set()
+        # the apply loop itself calls stop() on should_stop
+        # (server.go:295-298); a thread cannot join itself
+        if self._thread is not None \
+                and self._thread is not threading.current_thread():
+            self._thread.join(timeout=5)
+
+    # -- raft message input ------------------------------------------------
+
+    def process(self, m: Message) -> None:
+        """Peer /raft endpoint feeds here (server.go:243-245)."""
+        self.node.step(m)
+
+    # -- the apply loop ----------------------------------------------------
+
+    def run(self) -> None:
+        """Reference server.go:247-323."""
+        is_leader = False
+        snapi = 0
+        appliedi = 0
+        nodes: list[int] = []
+        next_tick = time.monotonic() + self.tick_interval
+        next_sync = time.monotonic() + self.sync_interval
+
+        while not self.done.is_set():
+            now = time.monotonic()
+            if now >= next_tick:
+                self.node.tick()
+                next_tick = now + self.tick_interval
+            if is_leader and now >= next_sync:
+                self.sync(DEFAULT_SYNC_TIMEOUT)
+                next_sync = now + self.sync_interval
+
+            wait_for = min(next_tick - now,
+                           (next_sync - now) if is_leader else
+                           self.tick_interval)
+            rd = self.node.ready(timeout=max(wait_for, 0.001))
+            if rd is None:
+                continue
+
+            # persist BEFORE send (the Ready contract, node.go:41-60)
+            self.storage.save(rd.hard_state, rd.entries)
+            self.storage.save_snap(rd.snapshot)
+            self.send(rd.messages)
+
+            for e in rd.committed_entries:
+                if e.type == ENTRY_NORMAL:
+                    r = Request.unmarshal(e.data)
+                    self.w.trigger(r.id, self.apply_request(r))
+                elif e.type == ENTRY_CONF_CHANGE:
+                    cc = ConfChange.unmarshal(e.data)
+                    self.apply_conf_change(cc)
+                    self.w.trigger(cc.id, None)
+                else:  # pragma: no cover
+                    raise AssertionError("unexpected entry type")
+                self.raft_index = e.index
+                self.raft_term = e.term
+                appliedi = e.index
+
+            if rd.soft_state is not None:
+                nodes = rd.soft_state.nodes
+                is_leader = rd.soft_state.raft_state == STATE_LEADER
+                if rd.soft_state.should_stop:
+                    self.stop()
+                    return
+
+            if rd.snapshot.index > snapi:
+                snapi = rd.snapshot.index
+
+            # recover from snapshot if it is more updated than applied
+            # (server.go:306-311)
+            if rd.snapshot.index > appliedi:
+                self.store.recovery(rd.snapshot.data)
+                appliedi = rd.snapshot.index
+
+            if appliedi - snapi > self.snap_count:
+                self.snapshot(appliedi, nodes)
+                snapi = appliedi
+
+    # -- client request path -----------------------------------------------
+
+    def do(self, r: Request, timeout: float | None = None) -> Response:
+        """Propose writes/quorum-GETs through raft; serve plain
+        GET/watch locally (reference server.go:337-380)."""
+        if r.id == 0:
+            raise ValueError("r.id cannot be 0")
+        if r.method == "GET" and r.quorum:
+            r.method = "QGET"
+        if r.method in ("POST", "PUT", "DELETE", "QGET"):
+            data = r.marshal()
+            ch = self.w.register(r.id)
+            try:
+                self.node.propose(data, timeout=timeout)
+            except TimeoutError:
+                self.w.trigger(r.id, None)  # GC wait
+                raise
+            import queue as _q
+
+            try:
+                x = ch.get(timeout=timeout)
+            except _q.Empty:
+                self.w.trigger(r.id, None)  # GC wait
+                raise TimeoutError("request timed out")
+            if self.done.is_set() and x is None:
+                raise ServerStoppedError()
+            resp = x
+            if resp.err is not None:
+                raise resp.err
+            return resp
+        if r.method == "GET":
+            if r.wait:
+                wc = self.store.watch(r.path, r.recursive, r.stream,
+                                      r.since)
+                return Response(watcher=wc)
+            ev = self.store.get(r.path, r.recursive, r.sorted)
+            return Response(event=ev)
+        raise UnknownMethodError(r.method)
+
+    # -- membership --------------------------------------------------------
+
+    def add_member(self, memb: Member, timeout: float | None = None) -> None:
+        """Reference server.go:382-395."""
+        cc = ConfChange(id=gen_id(), type=CONF_CHANGE_ADD_NODE,
+                        node_id=memb.id,
+                        context=json.dumps(memb.to_dict()).encode())
+        self._configure(cc, timeout)
+
+    def remove_member(self, id: int, timeout: float | None = None) -> None:
+        cc = ConfChange(id=gen_id(), type=CONF_CHANGE_REMOVE_NODE,
+                        node_id=id)
+        self._configure(cc, timeout)
+
+    def _configure(self, cc: ConfChange,
+                   timeout: float | None = None) -> None:
+        """Reference server.go:417-433."""
+        ch = self.w.register(cc.id)
+        try:
+            self.node.propose_conf_change(cc, timeout=timeout)
+        except TimeoutError:
+            self.w.trigger(cc.id, None)
+            raise
+        import queue as _q
+
+        try:
+            ch.get(timeout=timeout)
+        except _q.Empty:
+            self.w.trigger(cc.id, None)
+            raise TimeoutError("conf change timed out")
+
+    # -- RaftTimer ---------------------------------------------------------
+
+    def index(self) -> int:
+        return self.raft_index
+
+    def term(self) -> int:
+        return self.raft_term
+
+    # -- periodic work -----------------------------------------------------
+
+    def sync(self, timeout: float) -> None:
+        """Leader-only SYNC proposal carrying wall time: applied
+        deterministically as DeleteExpiredKeys cluster-wide
+        (reference server.go:438-456)."""
+        req = Request(method="SYNC", id=gen_id(),
+                      time=int(time.time() * 1e9))
+        data = req.marshal()
+
+        def bg():
+            try:
+                self.node.propose(data, timeout=timeout)
+            except (TimeoutError, Exception):
+                pass
+
+        threading.Thread(target=bg, daemon=True).start()
+
+    def publish(self, retry_interval: float) -> None:
+        """Register server attributes under its member key
+        (reference server.go:463-491)."""
+        b = json.dumps(self.attributes)
+        req = Request(id=gen_id(), method="PUT",
+                      path=Member(id=self.id).store_key()
+                      + ATTRIBUTES_SUFFIX,
+                      val=b)
+        while not self.done.is_set():
+            try:
+                self.do(req, timeout=retry_interval)
+                log.info("etcdserver: published %s to the cluster",
+                         self.attributes)
+                return
+            except ServerStoppedError:
+                return
+            except Exception as e:
+                log.warning("etcdserver: publish error: %s", e)
+                req.id = gen_id()
+
+    # -- apply -------------------------------------------------------------
+
+    def apply_request(self, r: Request) -> Response:
+        """Map a committed Request onto a store call
+        (reference server.go:503-540)."""
+        expr = r.expiration / 1e9 if r.expiration else None
+
+        def f(call):
+            try:
+                return Response(event=call())
+            except EtcdError as e:
+                return Response(err=e)
+
+        if r.method == "POST":
+            return f(lambda: self.store.create(r.path, r.dir, r.val, True,
+                                               expr))
+        if r.method == "PUT":
+            exists, exists_set = r.prev_exist, r.prev_exist is not None
+            if exists_set:
+                if exists:
+                    return f(lambda: self.store.update(r.path, r.val, expr))
+                return f(lambda: self.store.create(r.path, r.dir, r.val,
+                                                   False, expr))
+            if r.prev_index > 0 or r.prev_value != "":
+                return f(lambda: self.store.compare_and_swap(
+                    r.path, r.prev_value, r.prev_index, r.val, expr))
+            return f(lambda: self.store.set(r.path, r.dir, r.val, expr))
+        if r.method == "DELETE":
+            if r.prev_index > 0 or r.prev_value != "":
+                return f(lambda: self.store.compare_and_delete(
+                    r.path, r.prev_value, r.prev_index))
+            return f(lambda: self.store.delete(r.path, r.dir, r.recursive))
+        if r.method == "QGET":
+            return f(lambda: self.store.get(r.path, r.recursive, r.sorted))
+        if r.method == "SYNC":
+            self.store.delete_expired_keys(r.time / 1e9)
+            return Response()
+        return Response(err=UnknownMethodError(r.method))
+
+    def apply_conf_change(self, cc: ConfChange) -> None:
+        """Reference server.go:542-559."""
+        self.node.apply_conf_change(cc)
+        if cc.type == CONF_CHANGE_ADD_NODE:
+            m = Member.from_dict(json.loads(cc.context))
+            if cc.node_id != m.id:
+                raise AssertionError("unexpected nodeID mismatch")
+            self.cluster_store.add(m)
+        elif cc.type == CONF_CHANGE_REMOVE_NODE:
+            self.cluster_store.remove(cc.node_id)
+        else:  # pragma: no cover
+            raise AssertionError("unexpected ConfChange type")
+
+    def snapshot(self, snapi: int, snapnodes: list[int]) -> None:
+        """Store snapshot -> raft compaction -> WAL cut
+        (reference server.go:562-571)."""
+        d = self.store.save()
+        self.node.compact(snapi, snapnodes, d)
+        self.storage.cut()
+
+
+def new_server(cfg: ServerConfig, *, discoverer=None,
+               post_fn=None) -> EtcdServer:
+    """Bootstrap/restart split (reference server.go:87-188)."""
+    cfg.verify()
+    snapdir = os.path.join(cfg.data_dir, "snap")
+    os.makedirs(snapdir, mode=0o700, exist_ok=True)
+    ss = Snapshotter(snapdir)
+    st = Store()
+    m = cfg.cluster.find_name(cfg.name)
+    waldir = os.path.join(cfg.data_dir, "wal")
+
+    if not wal_exist(waldir):
+        if cfg.discovery_url:
+            if discoverer is None:
+                from ..discovery import Discoverer
+
+                discoverer = Discoverer(cfg.discovery_url, m.id,
+                                        str(cfg.cluster))
+            s = discoverer.discover()
+            cfg.cluster.set_from_string(s)
+        elif cfg.cluster_state != "new":
+            raise RuntimeError(
+                "initial cluster state unset and no wal or discovery "
+                "URL found")
+        w = WAL.create(waldir, Info(id=m.id).marshal())
+        peers = [Peer(id=id, context=json.dumps(
+            cfg.cluster[id].to_dict()).encode())
+            for id in cfg.cluster.ids()]
+        n = start_node(m.id, peers, ELECTION_TICKS, HEARTBEAT_TICKS)
+    else:
+        if cfg.discovery_url:
+            log.warning(
+                "etcd: ignoring discovery URL: etcd has already been "
+                "initialized and has a valid log in %s", waldir)
+        index = 0
+        snapshot = None
+        try:
+            snapshot = ss.load()
+        except NoSnapshotError:
+            pass
+        if snapshot is not None:
+            log.info("etcdserver: restart from snapshot at index %d",
+                     snapshot.index)
+            st.recovery(snapshot.data)
+            index = snapshot.index
+        w = WAL.open_at_index(waldir, index)
+        md, hard_state, ents = w.read_all()
+        info = Info.unmarshal(md or b"")
+        if info.id != m.id:
+            raise RuntimeError(
+                f"unexpected nodeid {info.id:x}, want {m.id:x}")
+        n = restart_node(m.id, ELECTION_TICKS, HEARTBEAT_TICKS, snapshot,
+                         hard_state, ents)
+
+    cls = ClusterStore(st)
+    return EtcdServer(
+        store=st,
+        node=n,
+        id=m.id,
+        attributes={"Name": cfg.name,
+                    "ClientURLs": cfg.client_urls},
+        storage=WalSnapStorage(w, ss),
+        send=new_sender(cls, post_fn=post_fn),
+        cluster_store=cls,
+        snap_count=cfg.snap_count,
+    )

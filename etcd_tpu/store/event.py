@@ -3,7 +3,6 @@
 
 from __future__ import annotations
 
-import time as _time
 from dataclasses import dataclass, field
 
 # actions (reference store/event.go:3-12)

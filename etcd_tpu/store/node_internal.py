@@ -155,6 +155,21 @@ class Node:
 
     # -- representation ----------------------------------------------------
 
+    def load_extern(self, ext: NodeExtern, recursive: bool,
+                    sorted_: bool) -> None:
+        """loadInternalNode semantics (node_extern.go:24-55): a
+        directory ALWAYS lists its immediate non-hidden children;
+        ``recursive`` only controls deeper expansion."""
+        if self.is_dir():
+            ext.dir = True
+            ext.nodes = [c.repr(recursive, sorted_)
+                         for c in self.list() if not c.is_hidden()]
+            if sorted_:
+                ext.nodes.sort(key=lambda n: n.key)
+        else:
+            ext.value = self.value
+        ext.expiration, ext.ttl = self.expiration_and_ttl()
+
     def repr(self, recursive: bool, sorted_: bool) -> NodeExtern:
         """Reference node.go:254-305."""
         if self.is_dir():

@@ -12,7 +12,6 @@ from __future__ import annotations
 import json
 import posixpath
 import threading
-import time as _time
 
 from ..utils.errors import (
     ECODE_KEY_NOT_FOUND,
@@ -119,9 +118,7 @@ class Store:
                 raise
             e = new_event(GET, node_path, n.modified_index, n.created_index)
             e.etcd_index = self.current_index
-            ext = n.repr(recursive, sorted_)
-            e.node = ext
-            e.node.key = node_path
+            n.load_extern(e.node, recursive, sorted_)
             self.stats.inc(GET_SUCCESS)
             return e
 

@@ -1,0 +1,35 @@
+"""Request/response correlation registry (reference wait/wait.go).
+
+The seam where the async consensus pipeline re-synchronizes with
+blocked client handlers: a proposal registers its ID, the apply loop
+triggers it with the store response.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any
+
+
+class Wait:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._m: dict[int, queue.Queue] = {}
+
+    def register(self, id: int) -> queue.Queue:
+        with self._lock:
+            ch = self._m.get(id)
+            if ch is None:
+                ch = queue.Queue(maxsize=1)
+                self._m[id] = ch
+            return ch
+
+    def trigger(self, id: int, x: Any) -> None:
+        with self._lock:
+            ch = self._m.pop(id, None)
+        if ch is not None:
+            try:
+                ch.put_nowait(x)
+            except queue.Full:  # pragma: no cover
+                pass

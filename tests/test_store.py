@@ -181,6 +181,49 @@ def test_compare_and_delete():
         s.get("/foo", False, False)
 
 
+def test_nonrecursive_get_lists_immediate_children():
+    # loadInternalNode: a dir GET always lists one level; recursive
+    # only expands deeper (node_extern.go:24-55)
+    s = Store()
+    s.set("/dir/a", False, "1", PERMANENT)
+    s.set("/dir/b/deep", False, "2", PERMANENT)
+    g = s.get("/dir", False, True)
+    assert [n.key for n in g.node.nodes] == ["/dir/a", "/dir/b"]
+    # non-recursive: the child dir shows no grandchildren
+    sub = [n for n in g.node.nodes if n.key == "/dir/b"][0]
+    assert sub.dir and not sub.nodes
+    # recursive: grandchildren appear
+    g = s.get("/dir", True, True)
+    sub = [n for n in g.node.nodes if n.key == "/dir/b"][0]
+    assert [n.key for n in sub.nodes] == ["/dir/b/deep"]
+
+
+def test_removed_member_server_self_stops():
+    # should_stop path: the apply loop calls stop() from its own
+    # thread; must not try to join itself
+    import threading as _t
+    from etcd_tpu.server import EtcdServer
+
+    s = EtcdServer.__new__(EtcdServer)
+    s.node = type("N", (), {"stop": lambda self: None})()
+    s.done = _t.Event()
+    result = {}
+
+    def fake_run():
+        s._thread = _t.current_thread()
+        try:
+            s.stop()
+            result["ok"] = True
+        except RuntimeError as e:  # pragma: no cover
+            result["err"] = e
+
+    t = _t.Thread(target=fake_run)
+    s._thread = t
+    t.start()
+    t.join()
+    assert result.get("ok")
+
+
 def test_hidden_nodes_not_listed():
     s = Store()
     s.create("/foo/_hidden", False, "secret", False, PERMANENT)
