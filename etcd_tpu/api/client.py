@@ -1,0 +1,110 @@
+"""Python client library for the v2 API (reference client/http.go,
+client.go: Create/Get/Watch actions over HTTP with cancellable
+round trips and long-poll watchers)."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+
+class ClientError(Exception):
+    def __init__(self, code: int, body: dict | str):
+        self.code = code
+        self.body = body
+        super().__init__(f"HTTP {code}: {body}")
+
+
+class Client:
+    """Minimal v2 client (the reference's is just what discovery
+    needs; ours adds delete/set for the CLI and tests)."""
+
+    def __init__(self, endpoints: list[str], timeout: float = 5.0):
+        if not endpoints:
+            raise ValueError("no endpoints")
+        self.endpoints = [e.rstrip("/") for e in endpoints]
+        self.timeout = timeout
+
+    # -- http --------------------------------------------------------------
+
+    def _do(self, method: str, path: str, params: dict | None = None,
+            form: dict | None = None, timeout: float | None = None):
+        last_err: Exception = ClientError(0, "no endpoints tried")
+        for ep in self.endpoints:
+            url = ep + "/v2/keys" + path
+            if params:
+                url += "?" + urllib.parse.urlencode(params)
+            data = urllib.parse.urlencode(form).encode() if form else None
+            req = urllib.request.Request(url, data=data, method=method)
+            if data:
+                req.add_header("Content-Type",
+                               "application/x-www-form-urlencoded")
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=timeout or self.timeout) as resp:
+                    body = resp.read().decode()
+                    out = json.loads(body) if body.strip() else {}
+                    out["etcdIndex"] = int(
+                        resp.headers.get("X-Etcd-Index") or 0)
+                    return out
+            except urllib.error.HTTPError as e:
+                body = e.read().decode()
+                try:
+                    parsed = json.loads(body)
+                except json.JSONDecodeError:
+                    parsed = body
+                raise ClientError(e.code, parsed) from None
+            except (urllib.error.URLError, OSError) as e:
+                last_err = e
+                continue
+        raise last_err
+
+    # -- actions (reference client/http.go:184-247) ------------------------
+
+    def create(self, key: str, value: str, ttl: int | None = None):
+        form = {"value": value, "prevExist": "false"}
+        if ttl is not None:
+            form["ttl"] = str(ttl)
+        return self._do("PUT", key, form=form)
+
+    def set(self, key: str, value: str, ttl: int | None = None, **extra):
+        form = {"value": value}
+        if ttl is not None:
+            form["ttl"] = str(ttl)
+        form.update({k: str(v) for k, v in extra.items()})
+        return self._do("PUT", key, form=form)
+
+    def get(self, key: str, recursive: bool = False, sorted: bool = False,
+            quorum: bool = False):
+        params = {}
+        if recursive:
+            params["recursive"] = "true"
+        if sorted:
+            params["sorted"] = "true"
+        if quorum:
+            params["quorum"] = "true"
+        return self._do("GET", key, params=params)
+
+    def delete(self, key: str, recursive: bool = False, dir: bool = False,
+               **extra):
+        params = {}
+        if recursive:
+            params["recursive"] = "true"
+        if dir:
+            params["dir"] = "true"
+        params.update({k: str(v) for k, v in extra.items()})
+        return self._do("DELETE", key, params=params)
+
+    def watch(self, key: str, wait_index: int | None = None,
+              recursive: bool = False, timeout: float | None = None):
+        """Single long-poll watch (reference Watcher.Next,
+        client/http.go:164-177)."""
+        params = {"wait": "true"}
+        if wait_index is not None:
+            params["waitIndex"] = str(wait_index)
+        if recursive:
+            params["recursive"] = "true"
+        return self._do("GET", key, params=params,
+                        timeout=timeout or 330.0)

@@ -1,0 +1,239 @@
+"""CLI entry point (reference main.go): etcd-compatible flags and
+ETCD_* env fallback; etcd mode or proxy mode.
+
+Run as ``python -m etcd_tpu.cli --name node1 --data-dir /var/etcd ...``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import urllib.parse
+
+from . import __version__
+from .api import make_client_handler, make_peer_handler, serve
+from .api.proxy import NewProxyHandler
+from .server import (
+    Cluster,
+    DEFAULT_SNAP_COUNT,
+    ServerConfig,
+    new_server,
+)
+from .utils.flags import (
+    DEPRECATED_FLAGS,
+    IGNORED_FLAGS,
+    PROXY_VALUES,
+    PROXY_VALUE_OFF,
+    PROXY_VALUE_READONLY,
+    parse_cors,
+    set_flags_from_env,
+    urls_from_flags,
+    validate_urls,
+)
+from .utils.transport import TLSInfo, new_listener_context
+
+log = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    """Flag registry (reference main.go:27-99)."""
+    p = argparse.ArgumentParser(
+        prog="etcd-tpu", add_help=True,
+        description="TPU-native etcd: highly-available key value store")
+    p.add_argument("--name", default="default",
+                   help="Unique human-readable name for this node")
+    p.add_argument("--data-dir", default="",
+                   help="Path to the data directory")
+    p.add_argument("--discovery", default="",
+                   help="Discovery service used to bootstrap the cluster")
+    p.add_argument("--snapshot-count", type=int,
+                   default=DEFAULT_SNAP_COUNT,
+                   help="Number of committed transactions to trigger a "
+                        "snapshot")
+    p.add_argument("--version", action="store_true",
+                   help="Print the version and exit")
+    p.add_argument("--initial-cluster",
+                   default="default=http://localhost:2380,"
+                           "default=http://localhost:7001",
+                   help="Initial cluster configuration for bootstrapping")
+    p.add_argument("--initial-cluster-state", default="new",
+                   choices=["new"],
+                   help="Initial cluster state")
+    p.add_argument("--advertise-peer-urls",
+                   default="http://localhost:2380,http://localhost:7001")
+    p.add_argument("--advertise-client-urls",
+                   default="http://localhost:2379,http://localhost:4001")
+    p.add_argument("--listen-peer-urls",
+                   default="http://localhost:2380,http://localhost:7001")
+    p.add_argument("--listen-client-urls",
+                   default="http://localhost:2379,http://localhost:4001")
+    p.add_argument("--cors", default="",
+                   help="Comma-separated white list of origins for CORS")
+    p.add_argument("--proxy", default=PROXY_VALUE_OFF,
+                   choices=list(PROXY_VALUES))
+    p.add_argument("--ca-file", default="")
+    p.add_argument("--cert-file", default="")
+    p.add_argument("--key-file", default="")
+    p.add_argument("--peer-ca-file", default="")
+    p.add_argument("--peer-cert-file", default="")
+    p.add_argument("--peer-key-file", default="")
+    p.add_argument("--storage-backend", default="auto",
+                   choices=["auto", "tpu", "host"],
+                   help="Data-plane backend: tpu uses the device replay/"
+                        "hash kernels when a device is present")
+    # v0.4.6 back-compat (main.go:87-98)
+    p.add_argument("--addr", default=None,
+                   help="DEPRECATED: Use --advertise-client-urls instead.")
+    p.add_argument("--bind-addr", default=None,
+                   help="DEPRECATED: Use --listen-client-urls instead.")
+    p.add_argument("--peer-addr", default=None,
+                   help="DEPRECATED: Use --advertise-peer-urls instead.")
+    p.add_argument("--peer-bind-addr", default=None,
+                   help="DEPRECATED: Use --listen-peer-urls instead.")
+    for f in IGNORED_FLAGS:
+        p.add_argument(f"--{f}", nargs="?", const="", default=None,
+                       help=argparse.SUPPRESS)
+    for f in DEPRECATED_FLAGS:
+        p.add_argument(f"--{f}", default=None, help=argparse.SUPPRESS)
+    return p
+
+
+def _explicit_flags(argv: list[str]) -> set[str]:
+    out = set()
+    for a in argv:
+        if a.startswith("--"):
+            out.add(a[2:].split("=", 1)[0])
+        elif a.startswith("-") and len(a) > 1:
+            out.add(a[1:].split("=", 1)[0])
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s: %(message)s")
+    argv = argv if argv is not None else sys.argv[1:]
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    explicit = _explicit_flags(argv)
+
+    if args.version:
+        print("etcd version", __version__)
+        return 0
+
+    for f in DEPRECATED_FLAGS:
+        if getattr(args, f.replace("-", "_")) is not None:
+            print(f'flag "--{f}" is no longer supported.', file=sys.stderr)
+            return 1
+    for f in IGNORED_FLAGS:
+        if getattr(args, f.replace("-", "_"), None) is not None:
+            log.warning('flag "--%s" is no longer supported - ignoring.', f)
+
+    set_flags_from_env(parser, args, explicit)
+
+    cluster = Cluster()
+    if args.discovery:
+        # temporary self-only cluster until discovery completes
+        # (reference main.go:253-275)
+        apurls = urls_from_flags(args, "advertise_peer_urls", "peer_addr",
+                                 explicit)
+        cluster.set_from_string(
+            ",".join(f"{args.name}={u}" for u in apurls))
+    else:
+        cluster.set_from_string(args.initial_cluster)
+
+    if args.proxy == PROXY_VALUE_OFF:
+        return start_etcd(args, cluster, explicit)
+    return start_proxy(args, cluster, explicit)
+
+
+def start_etcd(args, cluster: Cluster, explicit: set[str]) -> int:
+    """Reference startEtcd (main.go:126-209)."""
+    self_m = cluster.find_name(args.name)
+    if self_m is None:
+        log.error("etcd: no member with name=%r exists", args.name)
+        return 1
+
+    data_dir = args.data_dir
+    if not data_dir:
+        data_dir = f"{self_m.id}_etcd_data"
+        log.info("main: no data-dir provided, using default data-dir "
+                 "./%s", data_dir)
+    os.makedirs(data_dir, mode=0o700, exist_ok=True)
+
+    client_tls = TLSInfo(args.cert_file, args.key_file, args.ca_file)
+    peer_tls = TLSInfo(args.peer_cert_file, args.peer_key_file,
+                       args.peer_ca_file)
+
+    acurls = urls_from_flags(args, "advertise_client_urls", "addr",
+                             explicit, client_tls.empty())
+    cfg = ServerConfig(
+        name=args.name,
+        client_urls=acurls,
+        data_dir=data_dir,
+        snap_count=args.snapshot_count,
+        cluster=cluster,
+        discovery_url=args.discovery,
+        cluster_state=args.initial_cluster_state,
+    )
+    s = new_server(cfg)
+    s.start()
+
+    cors = parse_cors(args.cors) if args.cors else None
+    ch = make_client_handler(s, cors=cors)
+    ph = make_peer_handler(s)
+
+    lpurls = urls_from_flags(args, "listen_peer_urls", "peer_bind_addr",
+                             explicit, peer_tls.empty())
+    for u in lpurls:
+        host, port = _split_hostport(u)
+        serve(ph, host, port, new_listener_context(peer_tls))
+        log.info("Listening for peers on %s", u)
+
+    lcurls = urls_from_flags(args, "listen_client_urls", "bind_addr",
+                             explicit, client_tls.empty())
+    for u in lcurls:
+        host, port = _split_hostport(u)
+        serve(ch, host, port, new_listener_context(client_tls))
+        log.info("Listening for client requests on %s", u)
+
+    _block_forever()
+    return 0
+
+
+def start_proxy(args, cluster: Cluster, explicit: set[str]) -> int:
+    """Reference startProxy (main.go:212-249)."""
+    client_tls = TLSInfo(args.cert_file, args.key_file, args.ca_file)
+    addrs = [urllib.parse.urlsplit(u).netloc
+             for u in cluster.peer_urls_all()]
+    scheme = "https" if not client_tls.empty() else "http"
+    handler = NewProxyHandler(
+        addrs, scheme=scheme,
+        readonly=args.proxy == PROXY_VALUE_READONLY)
+
+    lcurls = urls_from_flags(args, "listen_client_urls", "bind_addr",
+                             explicit, client_tls.empty())
+    for u in lcurls:
+        host, port = _split_hostport(u)
+        serve(handler, host, port, new_listener_context(client_tls))
+        log.info("Listening for client requests on %s", u)
+
+    _block_forever()
+    return 0
+
+
+def _split_hostport(u: str) -> tuple[str, int]:
+    parsed = urllib.parse.urlsplit(u)
+    return parsed.hostname or "", parsed.port or 0
+
+
+def _block_forever() -> None:  # pragma: no cover
+    import threading
+
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

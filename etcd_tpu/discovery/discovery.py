@@ -23,6 +23,11 @@ class DiscoveryError(Exception):
     pass
 
 
+class ClusterFullError(DiscoveryError):
+    """The discovery token's size is already satisfied by other peers
+    (reference ErrFullCluster, discovery.go:149-157)."""
+
+
 class Discoverer:
     def __init__(self, durl: str, id: int, config: str, client=None):
         """``client`` implements create/get/watch against the discovery
@@ -67,8 +72,20 @@ class Discoverer:
                 nodes = [n for n in resp["node"].get("nodes", [])
                          if not n["key"].rsplit("/", 1)[-1].startswith("_")]
                 nodes.sort(key=lambda n: n.get("createdIndex", 0))
+                # a late joiner cut off by the size limit must abort,
+                # not bootstrap without itself (discovery.go:149-157)
+                selected = nodes[:size]
+                self_key = f"/{self.cluster}/{self.id:x}"
+                if len(nodes) > size and not any(
+                        n["key"].endswith(f"/{self.id:x}")
+                        for n in selected):
+                    raise ClusterFullError(
+                        f"cluster is full: size={size}, "
+                        f"self={self_key}")
                 index = resp.get("etcdIndex", 0)
-                return nodes[:size], size, index
+                return selected, size, index
+            except ClusterFullError:
+                raise
             except Exception as e:
                 retry += 1
                 if retry > MAX_RETRY:
@@ -83,9 +100,19 @@ class Discoverer:
         all_nodes = list(nodes)
         watch_index = index
         while len(all_nodes) < size:
-            resp = self.client.watch(f"/{self.cluster}",
-                                     wait_index=watch_index + 1,
-                                     recursive=True)
+            try:
+                resp = self.client.watch(f"/{self.cluster}",
+                                         wait_index=watch_index + 1,
+                                         recursive=True)
+            except Exception as e:
+                log.info("discovery: watch error %s, retrying", e)
+                time.sleep(TIMEOUT_TIMESCALE)
+                continue
+            if not resp.get("node"):
+                # long-poll timed out with no event: re-watch
+                # (the reference retries via waitNodesRetry,
+                # discovery.go:176-186)
+                continue
             node = resp["node"]
             name = node["key"].rsplit("/", 1)[-1]
             watch_index = node.get("modifiedIndex", watch_index + 1)

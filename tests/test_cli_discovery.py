@@ -1,0 +1,202 @@
+"""CLI flag/env handling and discovery protocol tests (reference
+pkg/flag_test.go and discovery/discovery_test.go fake-client style)."""
+
+import os
+
+import pytest
+
+from etcd_tpu.cli import _explicit_flags, build_parser
+from etcd_tpu.discovery import Discoverer, DiscoveryError
+from etcd_tpu.discovery import discovery as disc_mod
+from etcd_tpu.utils.flags import (
+    parse_cors,
+    set_flags_from_env,
+    urls_from_flags,
+    validate_urls,
+)
+
+
+def test_validate_urls():
+    out = validate_urls("http://b:7001,http://a:7001")
+    assert out == ["http://a:7001", "http://b:7001"]  # sorted
+    with pytest.raises(ValueError):
+        validate_urls("ftp://a:1")
+    with pytest.raises(ValueError):
+        validate_urls("http://nohostport")
+    with pytest.raises(ValueError):
+        validate_urls("http://a:1/path")
+
+
+def test_parse_cors():
+    assert parse_cors("*") == {"*"}
+    assert parse_cors("http://a.com, http://b.com") == {
+        "http://a.com", "http://b.com"}
+
+
+def test_parser_defaults():
+    args = build_parser().parse_args([])
+    assert args.name == "default"
+    assert args.snapshot_count == 10000
+    assert "default=" in args.initial_cluster
+    assert args.proxy == "off"
+    assert args.storage_backend == "auto"
+
+
+def test_ignored_flags_accepted():
+    args = build_parser().parse_args(
+        ["--peer-heartbeat-interval", "50", "--snapshot"])
+    assert args is not None
+
+
+def test_env_fallback(monkeypatch):
+    parser = build_parser()
+    args = parser.parse_args(["--name", "fromflag"])
+    monkeypatch.setenv("ETCD_NAME", "fromenv")
+    monkeypatch.setenv("ETCD_DATA_DIR", "/env/dir")
+    set_flags_from_env(parser, args, {"name"})
+    # explicit flag wins; env fills the unset one (pkg/flag.go:73-88)
+    assert args.name == "fromflag"
+    assert args.data_dir == "/env/dir"
+
+
+def test_urls_from_flags_arbitration():
+    parser = build_parser()
+    args = parser.parse_args(
+        ["--advertise-client-urls", "http://a:4001"])
+    out = urls_from_flags(args, "advertise_client_urls", "addr",
+                          {"advertise-client-urls"})
+    assert out == ["http://a:4001"]
+    # deprecated addr flag used alone
+    args = parser.parse_args(["--addr", "1.2.3.4:4001"])
+    out = urls_from_flags(args, "advertise_client_urls", "addr", {"addr"})
+    assert out == ["http://1.2.3.4:4001"]
+    # both set -> error (pkg/flag.go:108-112)
+    args = parser.parse_args(["--addr", "1.2.3.4:4001",
+                              "--advertise-client-urls", "http://a:4001"])
+    with pytest.raises(ValueError):
+        urls_from_flags(args, "advertise_client_urls", "addr",
+                        {"addr", "advertise-client-urls"})
+
+
+def test_explicit_flags():
+    assert _explicit_flags(["--name", "x", "--data-dir=/d"]) == {
+        "name", "data-dir"}
+
+
+# -- discovery with a scripted fake client (discovery_test.go:307-380) ------
+
+class FakeClient:
+    def __init__(self, size, nodes, watch_events=()):
+        self.size = size
+        self.nodes = nodes
+        self.created = []
+        self.watch_events = list(watch_events)
+
+    def create(self, key, value):
+        self.created.append((key, value))
+        return {"node": {"key": key, "value": value}}
+
+    def get(self, key, recursive=False, sorted=False):
+        if key.endswith("/_config/size"):
+            return {"node": {"value": str(self.size)}}
+        return {"node": {"nodes": self.nodes}, "etcdIndex": 10}
+
+    def watch(self, key, wait_index=None, recursive=False):
+        if not self.watch_events:
+            raise AssertionError("unexpected watch")
+        return {"node": self.watch_events.pop(0)}
+
+
+def test_discovery_all_registered():
+    nodes = [
+        {"key": "/c/1", "value": "n1=http://a:7001", "createdIndex": 1},
+        {"key": "/c/2", "value": "n2=http://b:7001", "createdIndex": 2},
+        {"key": "/c/3", "value": "n3=http://c:7001", "createdIndex": 3},
+    ]
+    d = Discoverer("http://disc.example.com/c", 1, "n1=http://a:7001",
+                   client=FakeClient(3, nodes))
+    out = d.discover()
+    assert out == "n1=http://a:7001,n2=http://b:7001,n3=http://c:7001"
+
+
+def test_discovery_waits_for_peers():
+    nodes = [
+        {"key": "/c/1", "value": "n1=http://a:7001", "createdIndex": 1},
+    ]
+    events = [
+        {"key": "/c/_ignoreme", "value": "", "modifiedIndex": 11},
+        {"key": "/c/2", "value": "n2=http://b:7001", "modifiedIndex": 12},
+    ]
+    d = Discoverer("http://disc.example.com/c", 1, "n1=http://a:7001",
+                   client=FakeClient(2, nodes, events))
+    out = d.discover()
+    assert out == "n1=http://a:7001,n2=http://b:7001"
+
+
+def test_discovery_retries_then_fails(monkeypatch):
+    class FailingClient:
+        def create(self, key, value):
+            return {}
+
+        def get(self, key, **kw):
+            raise OSError("connection refused")
+
+    monkeypatch.setattr(disc_mod, "TIMEOUT_TIMESCALE", 0.001)
+    d = Discoverer("http://disc.example.com/c", 1, "x",
+                   client=FailingClient())
+    with pytest.raises(DiscoveryError):
+        d.discover()
+
+
+def test_discovery_full_cluster_rejected():
+    # a 3rd node against a size-2 token must abort, not bootstrap
+    # without itself (reference ErrFullCluster, discovery.go:149-157)
+    from etcd_tpu.discovery.discovery import ClusterFullError
+
+    nodes = [
+        {"key": "/c/1", "value": "n1=http://a:7001", "createdIndex": 1},
+        {"key": "/c/2", "value": "n2=http://b:7001", "createdIndex": 2},
+        {"key": "/c/3", "value": "n3=http://c:7001", "createdIndex": 3},
+    ]
+    d = Discoverer("http://disc.example.com/c", 3, "n3=http://c:7001",
+                   client=FakeClient(2, nodes))
+    with pytest.raises(ClusterFullError):
+        d.discover()
+
+
+def test_discovery_empty_watch_response_retries():
+    # a timed-out long poll returns no node; discovery re-watches
+    nodes = [
+        {"key": "/c/1", "value": "n1=http://a:7001", "createdIndex": 1},
+    ]
+
+    class TimeoutThenEventClient(FakeClient):
+        def __init__(self):
+            super().__init__(2, nodes)
+            self.calls = 0
+
+        def watch(self, key, wait_index=None, recursive=False):
+            self.calls += 1
+            if self.calls == 1:
+                return {"etcdIndex": 10}  # empty long-poll timeout
+            return {"node": {"key": "/c/2", "value": "n2=http://b:7001",
+                             "modifiedIndex": 12}}
+
+    c = TimeoutThenEventClient()
+    d = Discoverer("http://disc.example.com/c", 1, "n1=http://a:7001",
+                   client=c)
+    out = d.discover()
+    assert out == "n1=http://a:7001,n2=http://b:7001"
+    assert c.calls == 2
+
+
+def test_discovery_truncates_to_size():
+    nodes = [
+        {"key": "/c/1", "value": "n1=http://a:7001", "createdIndex": 1},
+        {"key": "/c/2", "value": "n2=http://b:7001", "createdIndex": 2},
+        {"key": "/c/3", "value": "n3=http://c:7001", "createdIndex": 3},
+    ]
+    d = Discoverer("http://disc.example.com/c", 1, "n1=http://a:7001",
+                   client=FakeClient(2, nodes))
+    out = d.discover()
+    assert out == "n1=http://a:7001,n2=http://b:7001"

@@ -1,0 +1,421 @@
+"""HTTP API tests (reference etcdserver/etcdhttp/http_test.go patterns:
+parseRequest validation matrix, watch streaming/timeout, raft
+endpoint; proxy and client layered on top)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from etcd_tpu.api import (
+    Client,
+    ClientError,
+    make_client_handler,
+    make_peer_handler,
+    parse_request,
+    serve,
+)
+from etcd_tpu.api.proxy import NewProxyHandler
+from etcd_tpu.utils.errors import (
+    ECODE_INDEX_NAN,
+    ECODE_INVALID_FIELD,
+    ECODE_INVALID_FORM,
+    ECODE_TTL_NAN,
+    EtcdError,
+)
+from etcd_tpu.wire import MSG_APP, Message
+
+from test_server import make_cluster, stop_cluster, wait_for_leader
+
+
+@pytest.fixture(scope="module")
+def live_server():
+    servers = make_cluster(1)
+    s = wait_for_leader(servers)
+    handler = make_client_handler(s, cors={"*"}, watch_timeout=5.0,
+                                  server_timeout=5.0)
+    httpd = serve(handler, "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    peer_handler = make_peer_handler(s)
+    peer_httpd = serve(peer_handler, "127.0.0.1", 0)
+    peer_port = peer_httpd.server_address[1]
+    yield {
+        "server": s,
+        "base": f"http://127.0.0.1:{port}",
+        "peer_base": f"http://127.0.0.1:{peer_port}",
+    }
+    httpd.shutdown()
+    peer_httpd.shutdown()
+    stop_cluster(servers)
+
+
+def http(method, url, form=None):
+    data = None
+    headers = {}
+    if form is not None:
+        data = urllib.parse.urlencode(form).encode()
+        headers["Content-Type"] = "application/x-www-form-urlencoded"
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers)
+    try:
+        resp = urllib.request.urlopen(req, timeout=10)
+        return resp.status, dict(resp.headers), resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read().decode()
+
+
+# -- parse_request validation matrix (http_test.go parseRequest cases) ------
+
+def pr(method="GET", path="/v2/keys/foo", **form):
+    return parse_request(method, path,
+                         {k: [v] for k, v in form.items()}, 1)
+
+
+def test_parse_request_basics():
+    r = pr("PUT", "/v2/keys/foo/bar", value="baz")
+    assert r.method == "PUT" and r.path == "/foo/bar" and r.val == "baz"
+    assert r.id == 1
+
+
+def test_parse_request_bad_prefix():
+    with pytest.raises(EtcdError) as ei:
+        parse_request("GET", "/bad/path", {}, 1)
+    assert ei.value.error_code == ECODE_INVALID_FORM
+
+
+@pytest.mark.parametrize("field,code", [
+    ("prevIndex", ECODE_INDEX_NAN),
+    ("waitIndex", ECODE_INDEX_NAN),
+])
+def test_parse_request_bad_index(field, code):
+    with pytest.raises(EtcdError) as ei:
+        pr(**{field: "garbage"})
+    assert ei.value.error_code == code
+    with pytest.raises(EtcdError):
+        pr(**{field: "-1"})
+
+
+@pytest.mark.parametrize("field", ["recursive", "sorted", "wait", "dir",
+                                   "stream"])
+def test_parse_request_bad_bool(field):
+    with pytest.raises(EtcdError) as ei:
+        pr(**{field: "maybe"})
+    assert ei.value.error_code == ECODE_INVALID_FIELD
+
+
+def test_parse_request_wait_on_non_get():
+    with pytest.raises(EtcdError) as ei:
+        pr("PUT", wait="true")
+    assert ei.value.error_code == ECODE_INVALID_FIELD
+
+
+def test_parse_request_empty_prev_value():
+    with pytest.raises(EtcdError) as ei:
+        pr("PUT", prevValue="")
+    assert ei.value.error_code == ECODE_INVALID_FIELD
+
+
+def test_parse_request_bad_ttl():
+    with pytest.raises(EtcdError) as ei:
+        pr("PUT", ttl="notanumber")
+    assert ei.value.error_code == ECODE_TTL_NAN
+
+
+def test_parse_request_ttl_sets_expiration():
+    r = pr("PUT", value="v", ttl="100")
+    assert r.expiration > time.time() * 1e9
+
+
+def test_parse_request_prev_exist():
+    assert pr("PUT", prevExist="true").prev_exist is True
+    assert pr("PUT", prevExist="false").prev_exist is False
+    assert pr("PUT").prev_exist is None
+
+
+# -- live HTTP endpoint ------------------------------------------------------
+
+def test_put_get_roundtrip(live_server):
+    base = live_server["base"]
+    status, headers, body = http("PUT", base + "/v2/keys/http/foo",
+                                 {"value": "bar"})
+    assert status == 201  # created
+    doc = json.loads(body)
+    assert doc["action"] == "set"
+    assert doc["node"]["value"] == "bar"
+    assert "X-Etcd-Index" in headers
+    assert "X-Raft-Index" in headers
+    assert "X-Raft-Term" in headers
+
+    status, headers, body = http("GET", base + "/v2/keys/http/foo")
+    assert status == 200
+    assert json.loads(body)["node"]["value"] == "bar"
+
+
+def test_put_update_returns_200(live_server):
+    base = live_server["base"]
+    http("PUT", base + "/v2/keys/http/upd", {"value": "1"})
+    status, _, body = http("PUT", base + "/v2/keys/http/upd",
+                           {"value": "2"})
+    assert status == 200
+    assert json.loads(body)["prevNode"]["value"] == "1"
+
+
+def test_get_missing_404(live_server):
+    status, headers, body = http("GET",
+                                 live_server["base"] + "/v2/keys/nope")
+    assert status == 404
+    doc = json.loads(body)
+    assert doc["errorCode"] == 100
+    assert "X-Etcd-Index" in headers
+
+
+def test_cas_precondition_fail_412(live_server):
+    base = live_server["base"]
+    http("PUT", base + "/v2/keys/http/cas", {"value": "a"})
+    status, _, body = http("PUT", base + "/v2/keys/http/cas",
+                           {"value": "b", "prevValue": "wrong"})
+    assert status == 412
+    assert json.loads(body)["errorCode"] == 101
+
+
+def test_post_unique_creates_in_order(live_server):
+    base = live_server["base"]
+    s1, _, b1 = http("POST", base + "/v2/keys/http/queue",
+                     {"value": "job1"})
+    s2, _, b2 = http("POST", base + "/v2/keys/http/queue",
+                     {"value": "job2"})
+    assert s1 == 201 and s2 == 201
+    k1 = json.loads(b1)["node"]["key"]
+    k2 = json.loads(b2)["node"]["key"]
+    assert k1 != k2
+    assert int(k1.rsplit("/", 1)[1]) < int(k2.rsplit("/", 1)[1])
+
+
+def test_delete_and_cad(live_server):
+    base = live_server["base"]
+    http("PUT", base + "/v2/keys/http/del", {"value": "x"})
+    status, _, body = http(
+        "DELETE", base + "/v2/keys/http/del?prevValue=wrong")
+    assert status == 412
+    status, _, body = http(
+        "DELETE", base + "/v2/keys/http/del?prevValue=x")
+    assert status == 200
+    assert json.loads(body)["action"] == "compareAndDelete"
+
+
+def test_method_not_allowed(live_server):
+    req = urllib.request.Request(
+        live_server["base"] + "/v2/keys/foo", method="PATCH")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=5)
+    assert ei.value.code == 405
+
+
+def test_unknown_path_404(live_server):
+    status, _, _ = http("GET", live_server["base"] + "/v2/other")
+    assert status == 404
+
+
+def test_machines_endpoint(live_server):
+    status, _, body = http("GET", live_server["base"] + "/v2/machines")
+    assert status == 200
+
+
+def test_watch_long_poll(live_server):
+    base = live_server["base"]
+    result = {}
+
+    def watch():
+        status, headers, body = http(
+            "GET", base + "/v2/keys/http/watched?wait=true")
+        result["status"] = status
+        result["body"] = body
+
+    t = threading.Thread(target=watch)
+    t.start()
+    time.sleep(0.3)
+    http("PUT", base + "/v2/keys/http/watched", {"value": "fired"})
+    t.join(timeout=10)
+    assert result["status"] == 200
+    assert json.loads(result["body"])["node"]["value"] == "fired"
+
+
+def test_watch_stream_gets_multiple_events(live_server):
+    base = live_server["base"]
+    url = base + "/v2/keys/http/stream?wait=true&stream=true"
+    got = []
+
+    def reader():
+        req = urllib.request.Request(url)
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            for _ in range(2):
+                line = resp.readline()
+                if line.strip():
+                    got.append(json.loads(line))
+
+    t = threading.Thread(target=reader)
+    t.start()
+    time.sleep(0.3)
+    http("PUT", base + "/v2/keys/http/stream", {"value": "1"})
+    time.sleep(0.1)
+    http("PUT", base + "/v2/keys/http/stream", {"value": "2"})
+    t.join(timeout=10)
+    assert [e["node"]["value"] for e in got] == ["1", "2"]
+
+
+def test_watch_history_catchup_via_wait_index(live_server):
+    base = live_server["base"]
+    _, _, body = http("PUT", base + "/v2/keys/http/hist", {"value": "old"})
+    idx = json.loads(body)["node"]["modifiedIndex"]
+    status, _, body = http(
+        "GET", base + f"/v2/keys/http/hist?wait=true&waitIndex={idx}")
+    assert status == 200
+    assert json.loads(body)["node"]["value"] == "old"
+
+
+def test_cors_headers(live_server):
+    status, headers, _ = http("GET", live_server["base"] + "/v2/machines")
+    assert headers.get("Access-Control-Allow-Origin") == "*"
+
+
+def test_raft_endpoint_rejects_garbage(live_server):
+    peer = live_server["peer_base"]
+    # an empty body is a valid (empty) proto — it decodes to msgHup
+    # which the node drops; the reference also replies 204
+    status, _, _ = http("POST", peer + "/raft")
+    assert status == 204
+    req = urllib.request.Request(peer + "/raft", data=b"\xff\xfe\x01",
+                                 method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=5)
+    assert ei.value.code == 400
+
+
+def test_raft_endpoint_accepts_message(live_server):
+    peer = live_server["peer_base"]
+    # a stale-term message is swallowed by the SM without effect
+    m = Message(type=MSG_APP, to=1, from_=99, term=0)
+    req = urllib.request.Request(
+        peer + "/raft", data=m.marshal(), method="POST",
+        headers={"Content-Type": "application/protobuf"})
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        assert resp.status == 204
+
+
+def test_percent_encoded_keys_decoded(live_server):
+    base = live_server["base"]
+    status, _, _ = http("PUT", base + "/v2/keys/enc/foo%20bar",
+                        {"value": "spaced"})
+    assert status == 201
+    # the decoded key and the encoded request target are the same node
+    s = live_server["server"]
+    assert s.store.get("/enc/foo bar", False, False).node.value == "spaced"
+    status, _, body = http("GET", base + "/v2/keys/enc/foo%20bar")
+    assert json.loads(body)["node"]["value"] == "spaced"
+
+
+def test_head_machines_has_no_body(live_server):
+    import http.client as hc
+
+    netloc = urllib.parse.urlsplit(live_server["base"]).netloc
+    host, port = netloc.split(":")
+    conn = hc.HTTPConnection(host, int(port), timeout=5)
+    conn.request("HEAD", "/v2/machines")
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.read() == b""
+    # connection stays usable (no desync): the next request on the
+    # same keep-alive socket parses cleanly
+    conn.request("GET", "/v2/keys/head-probe")
+    resp = conn.getresponse()
+    assert resp.status == 404
+    assert json.loads(resp.read())["errorCode"] == 100
+    conn.close()
+
+
+# -- client library ----------------------------------------------------------
+
+def test_client_round_trip(live_server):
+    c = Client([live_server["base"]])
+    c.set("/cli/key", "v1")
+    out = c.get("/cli/key")
+    assert out["node"]["value"] == "v1"
+    assert out["etcdIndex"] > 0
+    c.create("/cli/new", "x")
+    with pytest.raises(ClientError) as ei:
+        c.create("/cli/new", "again")
+    assert ei.value.code == 412
+    c.delete("/cli/key")
+    with pytest.raises(ClientError) as ei:
+        c.get("/cli/key")
+    assert ei.value.code == 404
+
+
+def test_client_watch(live_server):
+    c = Client([live_server["base"]])
+    out = c.set("/cli/w", "start")
+    idx = out["node"]["modifiedIndex"]
+    result = {}
+
+    def bg():
+        result["event"] = c.watch("/cli/w", wait_index=idx + 1, timeout=10)
+
+    t = threading.Thread(target=bg)
+    t.start()
+    time.sleep(0.2)
+    c.set("/cli/w", "next")
+    t.join(timeout=10)
+    assert result["event"]["node"]["value"] == "next"
+
+
+def test_client_failover_endpoints(live_server):
+    # first endpoint is dead; client falls through to the live one
+    c = Client(["http://127.0.0.1:1", live_server["base"]], timeout=1.0)
+    c.set("/cli/failover", "ok")
+    assert c.get("/cli/failover")["node"]["value"] == "ok"
+
+
+# -- proxy mode --------------------------------------------------------------
+
+def test_proxy_forwards_and_quarantines(live_server):
+    import urllib.parse as up
+
+    backend = up.urlsplit(live_server["base"]).netloc
+    handler = NewProxyHandler(["127.0.0.1:1", backend])
+    httpd = serve(handler, "127.0.0.1", 0)
+    try:
+        port = httpd.server_address[1]
+        base = f"http://127.0.0.1:{port}"
+        status, _, body = http("PUT", base + "/v2/keys/prox/a",
+                               {"value": "viaproxy"})
+        assert status == 201
+        assert json.loads(body)["node"]["value"] == "viaproxy"
+        # the dead endpoint got quarantined
+        dead = [e for e in handler.director.ep
+                if e.url.endswith(":1")][0]
+        assert not dead.available
+        status, _, body = http("GET", base + "/v2/keys/prox/a")
+        assert json.loads(body)["node"]["value"] == "viaproxy"
+    finally:
+        httpd.shutdown()
+
+
+def test_readonly_proxy_rejects_writes(live_server):
+    import urllib.parse as up
+
+    backend = up.urlsplit(live_server["base"]).netloc
+    handler = NewProxyHandler([backend], readonly=True)
+    httpd = serve(handler, "127.0.0.1", 0)
+    try:
+        port = httpd.server_address[1]
+        base = f"http://127.0.0.1:{port}"
+        status, _, _ = http("PUT", base + "/v2/keys/ro", {"value": "x"})
+        assert status == 501
+        status, _, _ = http("GET", base + "/v2/keys/prox/a")
+        assert status == 200
+    finally:
+        httpd.shutdown()
