@@ -1,0 +1,28 @@
+"""Device (JAX/XLA/Pallas) ops — the TPU data plane.
+
+These are the accelerated equivalents of the reference's hot scalar
+loops (SURVEY.md §2 ★ components):
+
+- ``crc_device``: batched CRC32-Castagnoli over record buffers — the
+  TPU-native form of pkg/crc/crc.go + wal/decoder.go's per-record
+  verify loop and snap/snapshotter.go's whole-blob hash.
+- ``quorum``: batched quorum commit-index order statistics — the
+  vmapped form of raft/raft.go:248-258 (maybeCommit's sorted median).
+"""
+
+from .crc_device import (
+    crc32c_batch,
+    chain_verify_device,
+    raw_crc_batch,
+    shift_crc_batch,
+)
+from .quorum import commit_index_batch, maybe_commit_batch
+
+__all__ = [
+    "crc32c_batch",
+    "chain_verify_device",
+    "raw_crc_batch",
+    "shift_crc_batch",
+    "commit_index_batch",
+    "maybe_commit_batch",
+]

@@ -1,0 +1,79 @@
+"""Pallas TPU kernel for the batched raw-CRC bit-matmul.
+
+The pure-XLA path materializes the 8x bit expansion ``[N, 8L]`` in HBM
+between the unpack and the matmul unless XLA fuses it; this kernel
+guarantees the expansion lives only in VMEM: each grid step DMAs a
+``[TILE, L]`` byte block in, unpacks bits on the VPU, and contracts
+with the resident ``[8L, 32]`` contribution matrix on the MXU.
+
+Output is parity bits ``[N, 32]`` (int32); the caller packs to uint32
+(a cheap fused elementwise op).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE = 256
+
+
+def _kernel(buf_ref, c_ref, out_ref):
+    # buf arrives as int8 (bitcast of uint8); recover 0..255 in int32.
+    x = buf_ref[:].astype(jnp.int32) & 0xFF  # [TILE, L]
+    tile, length = x.shape
+    # One [TILE, L] @ [L, 32] MXU contraction per bit plane: XOR over
+    # GF(2) = integer sum + final parity, so the 8 planes accumulate.
+    # c_ref rows are bit-plane-major: row k*L + i = bit k of byte i.
+    acc = jnp.zeros((tile, 32), jnp.int32)
+    for k in range(8):
+        bits = ((x >> k) & 1).astype(jnp.int8)
+        ck = c_ref[k * length:(k + 1) * length, :]
+        acc += jax.lax.dot_general(
+            bits, ck, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+    out_ref[:] = acc & 1
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def raw_crc_pallas(buf: jnp.ndarray, c: jnp.ndarray,
+                   interpret: bool = False) -> jnp.ndarray:
+    """Raw CRC states of right-aligned rows; uint32 [N].
+
+    ``buf`` [N, L] uint8, ``c`` [8L, 32] int8 contribution matrix.
+    N is padded up to a TILE multiple (zero rows give raw state 0 and
+    are sliced off).
+    """
+    n, length = buf.shape
+    n_pad = (n + TILE - 1) // TILE * TILE
+    buf8 = jax.lax.bitcast_convert_type(
+        jnp.pad(buf, ((0, n_pad - n), (0, 0))), jnp.int8)
+    # Reorder contribution rows from byte-major (8i+k) to
+    # bit-plane-major (k*L+i) for the kernel's per-plane slices.
+    c = c.reshape(length, 8, 32).transpose(1, 0, 2).reshape(8 * length, 32)
+    grid = (n_pad // TILE,)
+    parity = pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((n_pad, 32), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((TILE, length), lambda i: (i, 0),
+                         memory_space=pltpu.ANY
+                         if interpret else pltpu.VMEM),
+            pl.BlockSpec((8 * length, 32), lambda i: (0, 0),
+                         memory_space=pltpu.ANY
+                         if interpret else pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((TILE, 32), lambda i: (i, 0),
+                               memory_space=pltpu.ANY
+                               if interpret else pltpu.VMEM),
+        interpret=interpret,
+    )(buf8, c)
+    bits32 = jnp.arange(32, dtype=jnp.uint32)
+    packed = jnp.sum(parity.astype(jnp.uint32) << bits32, axis=1,
+                     dtype=jnp.uint32)
+    return packed[:n]

@@ -1,0 +1,56 @@
+"""Batched quorum commit-index computation.
+
+The reference computes the commit index by sorting the match indices
+and taking the q-th largest, once, for one group, with a "TODO:
+optimize.. Currently naive" comment (raft/raft.go:248-258).  Here the
+same order statistic runs for every co-hosted group at once: one sort
+along the member axis of a ``[G, M]`` match matrix.
+
+``maybe_commit_batch`` reproduces raft/log.go:88-95's guard: the new
+commit index must exceed the current one AND the entry at that index
+must carry the current term (a leader may only commit entries of its
+own term — the Raft safety rule the reference encodes in
+``l.term(maxIndex) == term``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def commit_index_batch(match: jnp.ndarray, nmembers: jnp.ndarray
+                       ) -> jnp.ndarray:
+    """Quorum commit candidate per group: int32 [G].
+
+    ``match`` [G, M] per-member match indices (unused member slots
+    must hold 0); ``nmembers`` [G] live member counts.  Quorum size is
+    ``n//2 + 1`` (raft/raft.go:275-277); the candidate is the q-th
+    largest live match value.  Zero-filled dead slots sort low and
+    cannot displace live values because q <= n.
+    """
+    g, m = match.shape
+    srt = jnp.sort(match, axis=1)[:, ::-1]  # descending
+    q = nmembers // 2 + 1
+    return jnp.take_along_axis(srt, (q - 1)[:, None], axis=1)[:, 0]
+
+
+@jax.jit
+def maybe_commit_batch(match: jnp.ndarray, nmembers: jnp.ndarray,
+                       committed: jnp.ndarray, term: jnp.ndarray,
+                       log_terms: jnp.ndarray, offset: jnp.ndarray
+                       ) -> jnp.ndarray:
+    """New commit index per group: int32 [G].
+
+    ``log_terms`` [G, CAP] is the term of entry (offset + slot);
+    ``offset`` [G] the compaction offset (raft/log.go:13-24).  Commits
+    advance only when the candidate index's entry term equals the
+    leader's current term (raft/log.go:88-95).
+    """
+    mci = commit_index_batch(match, nmembers)
+    cap = log_terms.shape[1]
+    slot = jnp.clip(mci - offset, 0, cap - 1)
+    t_at = jnp.take_along_axis(log_terms, slot[:, None], axis=1)[:, 0]
+    ok = (mci > committed) & (t_at == term)
+    return jnp.where(ok, mci, committed)

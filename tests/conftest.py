@@ -2,8 +2,16 @@
 
 Device-path tests run on a virtual 8-device CPU mesh so sharding
 semantics are exercised without TPU hardware (the driver separately
-dry-runs the multichip path; bench.py runs on the real chip).  The env
-vars must be set before jax is first imported anywhere in the process.
+dry-runs the multichip path; bench.py runs on the real chip).
+
+Two layers of forcing are needed:
+- ``XLA_FLAGS`` must carry the virtual device count before jax first
+  initializes a backend.
+- Some environments install a TPU-tunnel PJRT plugin that overrides
+  ``JAX_PLATFORMS`` at import time (registering platform order
+  "tunnel,cpu"), which makes env-var-only selection hang trying to
+  reach hardware; updating ``jax.config`` after import wins over that
+  hook, so tests always get the pure in-process CPU backend.
 """
 
 import os
@@ -14,3 +22,7 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
