@@ -62,14 +62,14 @@ def raw_crc_pallas(buf: jnp.ndarray, c: jnp.ndarray,
         grid=grid,
         in_specs=[
             pl.BlockSpec((TILE, length), lambda i: (i, 0),
-                         memory_space=pltpu.ANY
+                         memory_space=pl.ANY
                          if interpret else pltpu.VMEM),
             pl.BlockSpec((8 * length, 32), lambda i: (0, 0),
-                         memory_space=pltpu.ANY
+                         memory_space=pl.ANY
                          if interpret else pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((TILE, 32), lambda i: (i, 0),
-                               memory_space=pltpu.ANY
+                               memory_space=pl.ANY
                                if interpret else pltpu.VMEM),
         interpret=interpret,
     )(buf8, c)
