@@ -1,0 +1,119 @@
+"""North-star benchmark: WAL replay with CRC parity (BASELINE config 1).
+
+Pipeline measured (the rebuild's replay path, wal/replay_device.py):
+  native framing scan -> right-aligned row padding -> device batched
+  raw-CRC bit-matmul -> parallel rolling-chain verification.
+
+Baseline measured on the same machine: the reference's strictly
+sequential single-core hot loop (frame + proto parse + rolling
+hardware CRC32C per record, wal/wal.go:164-216) implemented in C++
+with SSE4.2 CRC — the same instruction Go's stdlib hash/crc32 uses,
+so this is an honest stand-in for `wal.ReadAll` entries/s/core.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "entries/s", "vs_baseline": N}
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+N_ENTRIES = int(os.environ.get("BENCH_ENTRIES", 1_000_000))
+PAYLOAD = int(os.environ.get("BENCH_PAYLOAD", 256))
+CHUNK = 1 << 19
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    from etcd_tpu import native
+
+    if not native.available():
+        log("native toolchain unavailable; cannot measure baseline")
+        print(json.dumps({"metric": "wal_replay_entries_per_sec_chip",
+                          "value": 0.0, "unit": "entries/s",
+                          "vs_baseline": 0.0}))
+        return
+
+    log(f"generating {N_ENTRIES} x {PAYLOAD}B WAL stream ...")
+    t0 = time.perf_counter()
+    blob = native.wal_gen(N_ENTRIES, PAYLOAD, start_index=1, seed=0)
+    log(f"  {blob.nbytes / 1e6:.0f} MB in {time.perf_counter() - t0:.2f}s")
+
+    # -- baseline: sequential single-core replay ---------------------------
+    t0 = time.perf_counter()
+    n, last_index, _ = native.replay_verify(blob, seed=0)
+    base_s = time.perf_counter() - t0
+    assert n == N_ENTRIES and last_index == N_ENTRIES
+    base_eps = N_ENTRIES / base_s
+    log(f"baseline (1-core C++/SSE4.2 sequential): {base_s:.3f}s "
+        f"= {base_eps / 1e6:.2f}M entries/s")
+
+    # -- device path -------------------------------------------------------
+    import jax
+
+    from etcd_tpu.ops.crc_device import chain_verify_device, raw_crc_batch
+
+    log(f"jax backend: {jax.default_backend()}, "
+        f"devices: {len(jax.devices())}")
+
+    types, crcs, doff, dlen, eidx, eterm, etype = native.wal_scan(blob)
+    width = -(-int(dlen.max()) // 64) * 64
+
+    def device_verify():
+        """Full pipeline: scan + pad + H2D + device CRC chain verify."""
+        types, crcs, doff, dlen, eidx, eterm, etype = native.wal_scan(blob)
+        n = types.shape[0]
+        all_ok = True
+        seed = 0
+        for lo in range(0, n, CHUNK):
+            hi = min(lo + CHUNK, n)
+            pad_hi = lo + CHUNK  # fixed chunk shape: one compilation
+            d_off = doff[lo:hi]
+            d_len = dlen[lo:hi]
+            if hi < pad_hi:
+                d_off = np.pad(d_off, (0, pad_hi - hi))
+                d_len = np.pad(d_len, (0, pad_hi - hi))
+            rows = native.pad_rows(blob, d_off, d_len, width)
+            stored = crcs[lo:hi]
+            if hi < pad_hi:
+                # zero-length padding rows: chain link holds iff
+                # stored == prev; replicate last real stored value.
+                stored = np.pad(stored, (0, pad_hi - hi),
+                                mode="edge")
+            raw = raw_crc_batch(rows)
+            ok = chain_verify_device(seed, stored, raw,
+                                     d_len.astype(np.uint32))
+            all_ok &= bool(np.asarray(ok).all())
+            seed = int(crcs[hi - 1])
+        return all_ok, n
+
+    log("compiling device path (warmup) ...")
+    t0 = time.perf_counter()
+    ok, _ = device_verify()
+    log(f"  warmup {time.perf_counter() - t0:.2f}s, ok={ok}")
+    assert ok
+
+    t0 = time.perf_counter()
+    ok, nrec = device_verify()
+    dev_s = time.perf_counter() - t0
+    assert ok
+    dev_eps = N_ENTRIES / dev_s
+    log(f"device pipeline: {dev_s:.3f}s = {dev_eps / 1e6:.2f}M entries/s "
+        f"({nrec} records verified)")
+
+    print(json.dumps({
+        "metric": "wal_replay_entries_per_sec_chip",
+        "value": round(dev_eps, 1),
+        "unit": "entries/s",
+        "vs_baseline": round(dev_eps / base_eps, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

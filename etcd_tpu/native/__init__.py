@@ -1,0 +1,190 @@
+"""ctypes bindings for the native WAL data-loader tier (native/walscan.cc).
+
+Builds the shared library on demand with ``make`` (g++ is in the
+image; the .so is not committed).  All functions fall back gracefully:
+``available()`` is False when no compiler/toolchain is present, and
+callers (wal.replay_device, bench.py) keep a pure-Python path.
+
+The native tier owns the byte-granular, branchy work the reference
+does in Go — framing (wal/decoder.go:30-35), proto field walks,
+single-core rolling-CRC replay (wal/wal.go:164-216) — while the
+batched checksum/commit math runs on device (ops/).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_SO = os.path.join(_DIR, "libwalscan.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+class NativeError(RuntimeError):
+    pass
+
+
+_ERRORS = {
+    -1: "truncated stream",
+    -2: "proto parse error",
+    -3: "capacity exceeded",
+    -4: "crc mismatch",
+}
+
+
+def _check(rc: int) -> int:
+    if rc < 0:
+        raise NativeError(_ERRORS.get(rc, f"native error {rc}"))
+    return rc
+
+
+def _build() -> bool:
+    src = os.path.join(_DIR, "walscan.cc")
+    if not os.path.exists(src):
+        return False
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(src):
+        return True
+    try:
+        subprocess.run(["make", "-C", _DIR, "libwalscan.so"],
+                       check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not _build():
+            return None
+        lib = ctypes.CDLL(_SO)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        lib.etcd_crc32c_update.restype = ctypes.c_uint32
+        lib.etcd_crc32c_update.argtypes = [ctypes.c_uint32, u8p,
+                                           ctypes.c_uint64]
+        lib.etcd_crc32c_raw.restype = ctypes.c_uint32
+        lib.etcd_crc32c_raw.argtypes = [ctypes.c_uint32, u8p,
+                                        ctypes.c_uint64]
+        lib.etcd_wal_count.restype = ctypes.c_int64
+        lib.etcd_wal_count.argtypes = [u8p, ctypes.c_uint64]
+        lib.etcd_wal_scan.restype = ctypes.c_int64
+        lib.etcd_wal_scan.argtypes = [u8p, ctypes.c_uint64, i64p, u32p,
+                                      u64p, u64p, u64p, u64p, u64p,
+                                      ctypes.c_uint64]
+        lib.etcd_replay_verify.restype = ctypes.c_int64
+        lib.etcd_replay_verify.argtypes = [u8p, ctypes.c_uint64,
+                                           ctypes.c_uint32, u64p, u64p]
+        lib.etcd_wal_gen.restype = ctypes.c_int64
+        lib.etcd_wal_gen.argtypes = [ctypes.c_uint64, ctypes.c_uint64,
+                                     ctypes.c_uint64, ctypes.c_uint32,
+                                     u8p, ctypes.c_uint64]
+        lib.etcd_pad_rows.restype = ctypes.c_int64
+        lib.etcd_pad_rows.argtypes = [u8p, u64p, u64p, ctypes.c_uint64,
+                                      ctypes.c_uint64, u8p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _u8(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def crc32c_update(crc: int, data) -> int:
+    lib = _load()
+    buf = np.frombuffer(memoryview(data), dtype=np.uint8) \
+        if not isinstance(data, np.ndarray) else data
+    if lib is None:
+        from ..crc import crc32c
+        return crc32c.update(crc, buf.tobytes())
+    return int(lib.etcd_crc32c_update(crc, _u8(buf), buf.size))
+
+
+def wal_scan(blob: np.ndarray):
+    """Framing pass: returns (types, crcs, data_off, data_len,
+    ent_index, ent_term, ent_type) numpy arrays, one per record."""
+    lib = _load()
+    if lib is None:
+        raise NativeError("native library unavailable")
+    # Exact-size allocation via a cheap length-hop sweep (avoids the
+    # ~6 bytes-of-array-per-WAL-byte worst-case preallocation).
+    cap = max(1, _check(lib.etcd_wal_count(_u8(blob), blob.size)))
+    types = np.empty(cap, np.int64)
+    crcs = np.empty(cap, np.uint32)
+    doff = np.empty(cap, np.uint64)
+    dlen = np.empty(cap, np.uint64)
+    eidx = np.empty(cap, np.uint64)
+    eterm = np.empty(cap, np.uint64)
+    etype = np.empty(cap, np.uint64)
+    u64 = ctypes.POINTER(ctypes.c_uint64)
+    n = _check(lib.etcd_wal_scan(
+        _u8(blob), blob.size,
+        types.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        crcs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        doff.ctypes.data_as(u64), dlen.ctypes.data_as(u64),
+        eidx.ctypes.data_as(u64), eterm.ctypes.data_as(u64),
+        etype.ctypes.data_as(u64), cap))
+    return (types[:n], crcs[:n], doff[:n], dlen[:n], eidx[:n], eterm[:n],
+            etype[:n])
+
+
+def replay_verify(blob: np.ndarray, seed: int = 0):
+    """Single-core sequential replay (baseline). Returns
+    (n_entries, last_index, last_term); raises on corruption."""
+    lib = _load()
+    if lib is None:
+        raise NativeError("native library unavailable")
+    li = ctypes.c_uint64()
+    lt = ctypes.c_uint64()
+    n = _check(lib.etcd_replay_verify(
+        _u8(blob), blob.size, seed, ctypes.byref(li), ctypes.byref(lt)))
+    return n, li.value, lt.value
+
+
+def wal_gen(n_entries: int, payload_len: int, start_index: int = 1,
+            seed: int = 0) -> np.ndarray:
+    """Generate a synthetic framed entry-record stream."""
+    lib = _load()
+    if lib is None:
+        raise NativeError("native library unavailable")
+    cap = n_entries * (payload_len + 64) + 64
+    out = np.empty(cap, np.uint8)
+    n = _check(lib.etcd_wal_gen(n_entries, payload_len, start_index,
+                                seed, _u8(out), cap))
+    return out[:n]
+
+
+def pad_rows(blob: np.ndarray, data_off: np.ndarray, data_len: np.ndarray,
+             width: int) -> np.ndarray:
+    """Right-align data spans into a zero-padded [n, width] buffer."""
+    lib = _load()
+    if lib is None:
+        raise NativeError("native library unavailable")
+    n = data_off.size
+    out = np.empty((n, width), np.uint8)
+    _check(lib.etcd_pad_rows(
+        _u8(blob),
+        np.ascontiguousarray(data_off, np.uint64).ctypes.data_as(
+            ctypes.POINTER(ctypes.c_uint64)),
+        np.ascontiguousarray(data_len, np.uint64).ctypes.data_as(
+            ctypes.POINTER(ctypes.c_uint64)),
+        n, width, _u8(out)))
+    return out

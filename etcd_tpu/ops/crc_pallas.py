@@ -19,23 +19,34 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-TILE = 256
+TILE = 1024
+# VMEM budget for the per-tile bit expansion ([TILE, 8L] int8 plus the
+# [TILE, L] int32 byte tile ≈ 12*TILE*L bytes). Tiles shrink for wide
+# records so multi-KB payloads still compile; ~6 MB leaves headroom in
+# a ~16 MB/core VMEM for the contribution matrix and output.
+_VMEM_BUDGET = 6 << 20
+
+
+def _tile_for(length: int) -> int:
+    t = TILE
+    while t > 8 and 12 * t * length > _VMEM_BUDGET:
+        t //= 2
+    return t
 
 
 def _kernel(buf_ref, c_ref, out_ref):
     # buf arrives as int8 (bitcast of uint8); recover 0..255 in int32.
     x = buf_ref[:].astype(jnp.int32) & 0xFF  # [TILE, L]
-    tile, length = x.shape
-    # One [TILE, L] @ [L, 32] MXU contraction per bit plane: XOR over
-    # GF(2) = integer sum + final parity, so the 8 planes accumulate.
+    # Unpack all 8 bit planes in VMEM (never HBM — that is the whole
+    # point of this kernel: the XLA path materializes the 8x bit
+    # expansion [N, 8L] in HBM) and contract in ONE MXU matmul
+    # [TILE, 8L] @ [8L, 32]: XOR over GF(2) = integer sum + parity.
     # c_ref rows are bit-plane-major: row k*L + i = bit k of byte i.
-    acc = jnp.zeros((tile, 32), jnp.int32)
-    for k in range(8):
-        bits = ((x >> k) & 1).astype(jnp.int8)
-        ck = c_ref[k * length:(k + 1) * length, :]
-        acc += jax.lax.dot_general(
-            bits, ck, dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32)
+    bits = jnp.concatenate(
+        [((x >> k) & 1).astype(jnp.int8) for k in range(8)], axis=1)
+    acc = jax.lax.dot_general(
+        bits, c_ref[:], dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
     out_ref[:] = acc & 1
 
 
@@ -49,26 +60,27 @@ def raw_crc_pallas(buf: jnp.ndarray, c: jnp.ndarray,
     are sliced off).
     """
     n, length = buf.shape
-    n_pad = (n + TILE - 1) // TILE * TILE
+    tile = _tile_for(length)
+    n_pad = (n + tile - 1) // tile * tile
     buf8 = jax.lax.bitcast_convert_type(
         jnp.pad(buf, ((0, n_pad - n), (0, 0))), jnp.int8)
     # Reorder contribution rows from byte-major (8i+k) to
     # bit-plane-major (k*L+i) for the kernel's per-plane slices.
     c = c.reshape(length, 8, 32).transpose(1, 0, 2).reshape(8 * length, 32)
-    grid = (n_pad // TILE,)
+    grid = (n_pad // tile,)
     parity = pl.pallas_call(
         _kernel,
         out_shape=jax.ShapeDtypeStruct((n_pad, 32), jnp.int32),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((TILE, length), lambda i: (i, 0),
+            pl.BlockSpec((tile, length), lambda i: (i, 0),
                          memory_space=pl.ANY
                          if interpret else pltpu.VMEM),
             pl.BlockSpec((8 * length, 32), lambda i: (0, 0),
                          memory_space=pl.ANY
                          if interpret else pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((TILE, 32), lambda i: (i, 0),
+        out_specs=pl.BlockSpec((tile, 32), lambda i: (i, 0),
                                memory_space=pl.ANY
                                if interpret else pltpu.VMEM),
         interpret=interpret,
