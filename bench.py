@@ -57,52 +57,28 @@ def main():
     # -- device path -------------------------------------------------------
     import jax
 
-    from etcd_tpu.ops.crc_device import chain_verify_device, raw_crc_batch
-
     log(f"jax backend: {jax.default_backend()}, "
         f"devices: {len(jax.devices())}")
 
-    types, crcs, doff, dlen, eidx, eterm, etype = native.wal_scan(blob)
-    width = -(-int(dlen.max()) // 64) * 64
+    from etcd_tpu.wal.replay_device import verify_chain_device
 
     def device_verify():
-        """Full pipeline: scan + pad + H2D + device CRC chain verify."""
+        """Full pipeline: scan + pad + H2D + device CRC chain verify
+        (the same code path the server's --storage-backend=tpu replay
+        uses, wal/replay_device.py)."""
         types, crcs, doff, dlen, eidx, eterm, etype = native.wal_scan(blob)
-        n = types.shape[0]
-        all_ok = True
-        seed = 0
-        for lo in range(0, n, CHUNK):
-            hi = min(lo + CHUNK, n)
-            pad_hi = lo + CHUNK  # fixed chunk shape: one compilation
-            d_off = doff[lo:hi]
-            d_len = dlen[lo:hi]
-            if hi < pad_hi:
-                d_off = np.pad(d_off, (0, pad_hi - hi))
-                d_len = np.pad(d_len, (0, pad_hi - hi))
-            rows = native.pad_rows(blob, d_off, d_len, width)
-            stored = crcs[lo:hi]
-            if hi < pad_hi:
-                # zero-length padding rows: chain link holds iff
-                # stored == prev; replicate last real stored value.
-                stored = np.pad(stored, (0, pad_hi - hi),
-                                mode="edge")
-            raw = raw_crc_batch(rows)
-            ok = chain_verify_device(seed, stored, raw,
-                                     d_len.astype(np.uint32))
-            all_ok &= bool(np.asarray(ok).all())
-            seed = int(crcs[hi - 1])
-        return all_ok, n
+        verify_chain_device(blob, types, crcs, doff, dlen,
+                            chunk_rows=CHUNK)
+        return types.shape[0]
 
     log("compiling device path (warmup) ...")
     t0 = time.perf_counter()
-    ok, _ = device_verify()
-    log(f"  warmup {time.perf_counter() - t0:.2f}s, ok={ok}")
-    assert ok
+    device_verify()
+    log(f"  warmup {time.perf_counter() - t0:.2f}s")
 
     t0 = time.perf_counter()
-    ok, nrec = device_verify()
+    nrec = device_verify()
     dev_s = time.perf_counter() - t0
-    assert ok
     dev_eps = N_ENTRIES / dev_s
     log(f"device pipeline: {dev_s:.3f}s = {dev_eps / 1e6:.2f}M entries/s "
         f"({nrec} records verified)")

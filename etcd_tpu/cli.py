@@ -114,6 +114,15 @@ def main(argv: list[str] | None = None) -> int:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s: %(message)s")
+    # Operator override for the device-replay JAX platform (e.g.
+    # ETCD_JAX_PLATFORMS=cpu on hosts whose PJRT plugin hijacks
+    # env-var platform selection); applied via jax.config, which wins
+    # over import-time plugin hooks.
+    plat = os.environ.get("ETCD_JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
     argv = argv if argv is not None else sys.argv[1:]
     parser = build_parser()
     args = parser.parse_args(argv)
@@ -177,6 +186,7 @@ def start_etcd(args, cluster: Cluster, explicit: set[str]) -> int:
         cluster=cluster,
         discovery_url=args.discovery,
         cluster_state=args.initial_cluster_state,
+        storage_backend=args.storage_backend,
     )
     s = new_server(cfg)
     s.start()

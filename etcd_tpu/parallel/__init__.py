@@ -8,15 +8,19 @@ doing the only cross-device communication (BASELINE config 5).
 """
 
 from .mesh import (
+    data_plane_step,
     group_mesh,
     make_replay_commit_step,
+    make_sharded_step,
     replay_commit_local,
     shard_leading,
 )
 
 __all__ = [
+    "data_plane_step",
     "group_mesh",
     "make_replay_commit_step",
+    "make_sharded_step",
     "replay_commit_local",
     "shard_leading",
 ]

@@ -36,6 +36,7 @@ from ..ops.crc_device import (
     raw_crc_batch,
 )
 from ..ops.quorum import maybe_commit_batch
+from ..raft.batched import GroupState, replication_round
 
 
 def group_mesh(n_devices: int | None = None) -> Mesh:
@@ -91,6 +92,90 @@ def replay_commit_local(buf, lens, stored, seed,
     return links_ok, new_committed
 
 
+def data_plane_step(buf, lens, stored, seed, state: GroupState,
+                    n_new, self_slot, resp_slots, resp_idx, resp_mask):
+    """The flagship single-chip step: one fused device round of
+
+    1. WAL-chunk CRC chain verification (north-star config 1), and
+    2. the batched-raft leader pipeline — append proposals, absorb
+       msgAppResp progress, advance quorum commit over all G groups
+       (north-star config 4; raft/batched.py:replication_round).
+
+    Returns ``(links_ok [N], state', err [G], n_committed [G])``.
+    Jittable as-is; the mesh-sharded form is make_sharded_step.
+    """
+    raw = raw_crc_batch(buf)
+    links_ok = chain_verify_device(seed, stored, raw, lens)
+    state, err, ncomm = replication_round(
+        state, n_new, self_slot, resp_slots, resp_idx, resp_mask)
+    return links_ok, state, err, ncomm
+
+
+def make_sharded_step(mesh: Mesh):
+    """jit-compiled mesh-sharded :func:`data_plane_step`.
+
+    Shardings: ``buf`` [N, L] over ``P('g', 's')`` (rows data-parallel,
+    bytes sequence-parallel with a psum'd GF(2) contraction); all
+    [G, ...] group state over ``P('g')``; the commit frontier is
+    ``all_gather``-ed over ICI so every device and the host apply loop
+    see the full vector (BASELINE config 5).
+    """
+    def step(buf, lens, stored, seed, state, n_new, self_slot,
+             resp_slots, resp_idx, resp_mask, c):
+        links_ok = _chain_links_local(buf, lens, stored, seed, c)
+        state, err, ncomm = replication_round(
+            state, n_new, self_slot, resp_slots, resp_idx, resp_mask)
+        commit_all = jax.lax.all_gather(state.commit, "g", tiled=True)
+        return links_ok, state, err, ncomm, commit_all
+
+    gspec = GroupState(*([P("g")] * len(GroupState._fields)))
+    mapped = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P("g", "s"), P("g"), P("g"), P(), gspec, P("g"),
+                  P("g"), P("g", None), P("g", None), P("g", None),
+                  P("s", None)),
+        out_specs=(P("g"), gspec, P("g"), P("g"), P()),
+        check_vma=False,  # all_gather output is replicated over 'g'
+    )
+
+    @jax.jit
+    def run(buf, lens, stored, seed, state, n_new, self_slot,
+            resp_slots, resp_idx, resp_mask):
+        buf = jnp.asarray(buf, dtype=jnp.uint8)
+        c = jnp.asarray(contribution_matrix(buf.shape[1]))
+        return mapped(buf, jnp.asarray(lens, jnp.int32),
+                      jnp.asarray(stored, jnp.uint32),
+                      jnp.asarray(seed, jnp.uint32), state,
+                      jnp.asarray(n_new, jnp.int32),
+                      jnp.asarray(self_slot, jnp.int32),
+                      jnp.asarray(resp_slots, jnp.int32),
+                      jnp.asarray(resp_idx, jnp.int32),
+                      jnp.asarray(resp_mask, bool), c)
+
+    return run
+
+
+def _chain_links_local(buf, lens, stored, seed, c):
+    """Shard-local body of the sequence-parallel CRC chain check:
+    psum the GF(2) contraction over 's', ppermute the chain seam
+    over 'g'.  Must run inside shard_map on a ('g', 's') mesh."""
+    bits = _unpack_bits(buf)  # [N_loc, 8*L_loc]
+    acc = jax.lax.dot_general(
+        bits, c, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    acc = jax.lax.psum(acc, "s")  # XOR = sum mod 2 across byte shards
+    raw = _from_bits32(acc & 1)
+
+    ng = jax.lax.psum(1, "g")
+    idx = jax.lax.axis_index("g")
+    last = stored[-1]
+    prev_last = jax.lax.ppermute(
+        last, "g", [(i, (i + 1) % ng) for i in range(ng)])
+    head_prev = jnp.where(idx == 0, seed.astype(jnp.uint32), prev_last)
+    prev = jnp.concatenate([head_prev[None], stored[:-1]])
+    return _chain_expected(prev, raw, lens.astype(jnp.uint32)) == stored
+
+
 def make_replay_commit_step(mesh: Mesh):
     """jit-compiled mesh-sharded variant of :func:`replay_commit_local`.
 
@@ -106,25 +191,7 @@ def make_replay_commit_step(mesh: Mesh):
     """
     def step(buf, lens, stored, seed, match, nmembers, committed,
              term, log_terms, offset, c):
-        # -- sequence-parallel raw CRC: local byte-range contraction.
-        bits = _unpack_bits(buf)  # [N_loc, 8*L_loc]
-        acc = jax.lax.dot_general(
-            bits, c, dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32)
-        acc = jax.lax.psum(acc, "s")  # XOR = sum mod 2 across byte shards
-        raw = _from_bits32(acc & 1)
-
-        # -- ring-stitch the chain seam across 'g' shards.
-        ng = jax.lax.psum(1, "g")
-        idx = jax.lax.axis_index("g")
-        last = stored[-1]
-        prev_last = jax.lax.ppermute(
-            last, "g", [(i, (i + 1) % ng) for i in range(ng)])
-        head_prev = jnp.where(idx == 0, seed.astype(jnp.uint32), prev_last)
-        prev = jnp.concatenate([head_prev[None], stored[:-1]])
-        links_ok = _chain_expected(prev, raw, lens.astype(jnp.uint32)) \
-            == stored
-
+        links_ok = _chain_links_local(buf, lens, stored, seed, c)
         # -- group-local quorum commit, then gather the frontier.
         new_committed = maybe_commit_batch(
             match, nmembers, committed, term, log_terms, offset)

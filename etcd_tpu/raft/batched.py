@@ -1,0 +1,300 @@
+"""Group-batched Raft engine: [G, ...] state arrays, masked XLA ops.
+
+The reference runs ONE raft group per process and its hot loops are
+scalar (`maybeCommit`'s sort, `log.append`/`findConflict` walks —
+raft/raft.go:248-258, raft/log.go:49-84).  Here tens of thousands of
+co-hosted groups step at once: state lives as leading-axis-``G``
+arrays in HBM and every hot-path transition is a masked, branchless
+batch op (BASELINE config 4).
+
+Design split (the TPU-first shape of the protocol):
+
+- **Device (this module)**: the *replication hot path* — follower
+  ``maybe_append`` (term match, conflict scan, truncating append,
+  commit advance), leader append + progress update + quorum commit,
+  election timers, vote up-to-dateness checks, log compaction.  All
+  pure functions of ``GroupState``; all jit/vmap/pjit-compatible
+  (shard the ``G`` axis with parallel/mesh.py).
+- **Host**: rare, branchy transitions — campaigns, config change,
+  message routing between members (DCN) — driven by the scalar core
+  (core.py), which doubles as the executable specification these ops
+  are property-tested against.
+
+Capacity model: each group's log is a CAP-slot window; slot ``s``
+holds the term of entry ``offset + s`` (slot 0 = the dummy/compacted
+entry, mirroring ``ents[0]`` in log.py).  Overflow and
+conflict-below-commit (a panic in the reference, raft/log.go:57)
+surface as per-group error lanes in the returned flags.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.quorum import commit_index_batch
+
+FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
+
+
+class GroupState(NamedTuple):
+    """Per-group consensus state, leading axis G (a jax pytree)."""
+
+    term: jnp.ndarray       # [G] i32 current term
+    vote: jnp.ndarray       # [G] i32 voted-for member slot (-1 none)
+    role: jnp.ndarray       # [G] i32 FOLLOWER/CANDIDATE/LEADER
+    lead: jnp.ndarray       # [G] i32 leader member slot (-1 none)
+    commit: jnp.ndarray     # [G] i32 commit index
+    applied: jnp.ndarray    # [G] i32 applied index
+    log_term: jnp.ndarray   # [G, CAP] i32 terms; slot s = idx offset+s
+    offset: jnp.ndarray     # [G] i32 compaction offset
+    last: jnp.ndarray       # [G] i32 last log index
+    match: jnp.ndarray      # [G, M] i32 leader view of peer match
+    next_: jnp.ndarray      # [G, M] i32 leader view of peer next
+    nmembers: jnp.ndarray   # [G] i32 live member count
+    elapsed: jnp.ndarray    # [G] i32 ticks since last reset
+    timeout: jnp.ndarray    # [G] i32 randomized election timeout
+
+    @property
+    def cap(self) -> int:
+        return self.log_term.shape[1]
+
+
+def init_groups(g: int, m: int, cap: int, election: int = 10) -> GroupState:
+    """Fresh follower groups at term 0 with empty logs."""
+    zi = jnp.zeros((g,), jnp.int32)
+    return GroupState(
+        term=zi, vote=zi - 1, role=zi + FOLLOWER, lead=zi - 1,
+        commit=zi, applied=zi,
+        log_term=jnp.zeros((g, cap), jnp.int32), offset=zi, last=zi,
+        match=jnp.zeros((g, m), jnp.int32),
+        next_=jnp.ones((g, m), jnp.int32),
+        nmembers=zi + m, elapsed=zi, timeout=zi + election,
+    )
+
+
+# ---------------------------------------------------------------------------
+# log primitives (batched forms of log.py / reference raft/log.go)
+# ---------------------------------------------------------------------------
+
+
+def term_at(log_term, offset, last, idx):
+    """Term of entry ``idx`` per group; 0 outside [offset, last].
+
+    ``idx`` may be [G] or [G, K] (absolute entry indices).
+    Batched ``RaftLog.term`` (log.go:117-124 via at()).
+    """
+    squeeze = idx.ndim == 1
+    if squeeze:
+        idx = idx[:, None]
+    cap = log_term.shape[1]
+    slot = idx - offset[:, None]
+    valid = (idx >= offset[:, None]) & (idx <= last[:, None]) & \
+        (slot < cap)
+    t = jnp.take_along_axis(log_term, jnp.clip(slot, 0, cap - 1), axis=1)
+    t = jnp.where(valid, t, 0)
+    return t[:, 0] if squeeze else t
+
+
+def match_term(log_term, offset, last, idx, term):
+    """Batched ``RaftLog.match_term`` — NB a term-0 entry at a valid
+    index cannot be distinguished from absence, exactly like the
+    reference where the dummy entry has term 0 (log.go:14-18)."""
+    in_range = (idx >= offset) & (idx <= last)
+    return in_range & (term_at(log_term, offset, last, idx) == term)
+
+
+def is_up_to_date(log_term, offset, last, cand_idx, cand_term):
+    """Batched ``RaftLog.is_up_to_date`` (log.go:136-139): vote grant
+    condition on candidate's (last index, last term)."""
+    lt = term_at(log_term, offset, last, last)
+    return (cand_term > lt) | ((cand_term == lt) & (cand_idx >= last))
+
+
+@jax.jit
+def maybe_append(state: GroupState, prev_idx, prev_term, ent_terms,
+                 n_ents, leader_commit, active=None):
+    """Follower replication step, batched ``RaftLog.maybe_append``
+    (log.go:49-69): term-match at prev, conflict scan, truncating
+    append, commit advance.
+
+    ``ent_terms`` [G, E] terms of incoming entries (entry j has index
+    prev_idx + 1 + j), ``n_ents`` [G] how many are real, ``active``
+    [G] bool mask of groups actually receiving an append (inactive
+    groups pass through unchanged).
+
+    Returns ``(state', ok, err)``: ``ok`` = the append was accepted
+    (msgAppResp success), ``err`` = a reference-panic condition
+    (conflict below commit, log.go:57; or capacity overflow).
+    """
+    g, cap = state.log_term.shape
+    e = ent_terms.shape[1]
+    if active is None:
+        active = jnp.ones((g,), bool)
+
+    ok = active & match_term(state.log_term, state.offset, state.last,
+                             prev_idx, prev_term)
+
+    # conflict scan (log.go:77-84) over the incoming window
+    e_idx = prev_idx[:, None] + 1 + jnp.arange(e, dtype=jnp.int32)
+    existing = term_at(state.log_term, state.offset, state.last, e_idx)
+    valid_e = jnp.arange(e) < n_ents[:, None]
+    mismatch = valid_e & ((e_idx > state.last[:, None]) |
+                          (existing != ent_terms))
+    conflict = mismatch.any(axis=1)
+    ci_rel = jnp.argmax(mismatch, axis=1)  # first mismatch position
+    ci = prev_idx + 1 + ci_rel
+    lastnewi = prev_idx + n_ents
+
+    err = ok & conflict & (ci <= state.commit)
+    err |= ok & (lastnewi - state.offset >= cap)
+
+    # truncating append as one masked window write: slots in
+    # [prev_idx+1, lastnewi] take the incoming terms (identical values
+    # where already matching, new values from the conflict point on)
+    cap_idx = state.offset[:, None] + jnp.arange(cap, dtype=jnp.int32)
+    j = cap_idx - (prev_idx[:, None] + 1)
+    write = ok[:, None] & (j >= 0) & (j < n_ents[:, None])
+    incoming = jnp.take_along_axis(
+        ent_terms, jnp.clip(j, 0, e - 1), axis=1)
+    log_term = jnp.where(write, incoming, state.log_term)
+
+    last = jnp.where(ok & conflict, lastnewi, state.last)
+    tocommit = jnp.minimum(leader_commit, lastnewi)
+    commit = jnp.where(ok & (tocommit > state.commit), tocommit,
+                       state.commit)
+    return state._replace(log_term=log_term, last=last,
+                          commit=commit), ok, err
+
+
+@jax.jit
+def leader_append(state: GroupState, n_new, self_slot, active=None):
+    """Leader-side ``append_entry`` (raft.go:279-286): append n_new
+    entries of the leader's term, update own progress.
+
+    Returns ``(state', err)`` with err = capacity overflow lanes.
+    """
+    g, cap = state.log_term.shape
+    if active is None:
+        active = jnp.ones((g,), bool)
+    active = active & (state.role == LEADER)
+
+    lastnew = state.last + n_new
+    err = active & (lastnew - state.offset >= cap)
+
+    cap_idx = state.offset[:, None] + jnp.arange(cap, dtype=jnp.int32)
+    write = active[:, None] & (cap_idx > state.last[:, None]) & \
+        (cap_idx <= lastnew[:, None])
+    log_term = jnp.where(write, state.term[:, None], state.log_term)
+
+    m = state.match.shape[1]
+    onehot = jax.nn.one_hot(self_slot, m, dtype=bool)
+    match = jnp.where(active[:, None] & onehot, lastnew[:, None],
+                      state.match)
+    next_ = jnp.where(active[:, None] & onehot, lastnew[:, None] + 1,
+                      state.next_)
+    last = jnp.where(active, lastnew, state.last)
+    return state._replace(log_term=log_term, last=last, match=match,
+                          next_=next_), err
+
+
+@jax.jit
+def progress_update(state: GroupState, from_slot, idx, active=None):
+    """Leader handling a successful msgAppResp (raft.go:456-463):
+    ``prs[from].update(idx)`` batched as a one-hot scatter."""
+    g, m = state.match.shape
+    if active is None:
+        active = jnp.ones((g,), bool)
+    active = active & (state.role == LEADER)
+    onehot = jax.nn.one_hot(from_slot, m, dtype=bool) & active[:, None]
+    match = jnp.where(onehot, jnp.maximum(state.match, idx[:, None]),
+                      state.match)
+    next_ = jnp.where(onehot, jnp.maximum(state.next_, idx[:, None] + 1),
+                      state.next_)
+    return state._replace(match=match, next_=next_)
+
+
+@jax.jit
+def maybe_commit(state: GroupState) -> GroupState:
+    """Quorum commit advance (raft.go:248-258 + log.go:88-95) for all
+    leader groups: q-th largest match, gated on current-term entry."""
+    mci = commit_index_batch(state.match, state.nmembers)
+    t_at = term_at(state.log_term, state.offset, state.last, mci)
+    ok = (state.role == LEADER) & (mci > state.commit) & \
+        (t_at == state.term)
+    return state._replace(commit=jnp.where(ok, mci, state.commit))
+
+
+@jax.jit
+def compact(state: GroupState, idx, active=None):
+    """Batched ``RaftLog.compact`` (log.go:161-169): slide the window
+    so slot 0 holds entry ``idx`` (which keeps its term for future
+    match checks).  err lanes where idx ∉ [offset, applied]."""
+    g, cap = state.log_term.shape
+    if active is None:
+        active = jnp.ones((g,), bool)
+    err = active & ((idx < state.offset) | (idx > state.applied))
+    do = active & ~err
+    shift = idx - state.offset
+    src = jnp.arange(cap, dtype=jnp.int32)[None, :] + shift[:, None]
+    rolled = jnp.take_along_axis(
+        state.log_term, jnp.clip(src, 0, cap - 1), axis=1)
+    keep = src[:, :] < cap
+    rolled = jnp.where(keep, rolled, 0)
+    return state._replace(
+        log_term=jnp.where(do[:, None], rolled, state.log_term),
+        offset=jnp.where(do, idx, state.offset)), err
+
+
+@jax.jit
+def tick(state: GroupState, heartbeat: int = 1):
+    """Batched tick (raft.go:288-301): advance timers, report which
+    groups fire an election timeout (followers/candidates) or a
+    heartbeat (leaders).  The host drains the fire masks and runs the
+    (rare) campaign logic through the scalar core."""
+    elapsed = state.elapsed + 1
+    elect = (state.role != LEADER) & (elapsed >= state.timeout)
+    beat = (state.role == LEADER) & (elapsed >= heartbeat)
+    elapsed = jnp.where(elect | beat, 0, elapsed)
+    return state._replace(elapsed=elapsed), elect, beat
+
+
+@jax.jit
+def grant_vote(state: GroupState, cand_idx, cand_term, msg_term,
+               cand_slot, active=None):
+    """Vote grant decision batched (raft.go:511-518): term check,
+    not-voted-or-same check, log up-to-dateness."""
+    g = state.term.shape[0]
+    if active is None:
+        active = jnp.ones((g,), bool)
+    utd = is_up_to_date(state.log_term, state.offset, state.last,
+                        cand_idx, cand_term)
+    free = (state.vote == -1) | (state.vote == cand_slot)
+    grant = active & (msg_term >= state.term) & free & utd
+    vote = jnp.where(grant, cand_slot, state.vote)
+    return state._replace(vote=vote), grant
+
+
+@jax.jit
+def replication_round(state: GroupState, n_new, self_slot,
+                      resp_slots, resp_idx, resp_mask):
+    """One fused leader-side pipeline step (the flagship batch op):
+
+    1. append ``n_new`` proposals per leader group (raft.go:279),
+    2. absorb a [G, R] batch of msgAppResp progress updates
+       (raft.go:456-463) — R responses per group, masked,
+    3. advance quorum commit (raft.go:248).
+
+    Returns ``(state', err, n_committed)`` where n_committed is the
+    per-group count of newly committed entries this round.
+    """
+    before = state.commit
+    state, err = leader_append(state, n_new, self_slot)
+    r = resp_slots.shape[1]
+    for k in range(r):
+        state = progress_update(state, resp_slots[:, k], resp_idx[:, k],
+                                active=resp_mask[:, k])
+    state = maybe_commit(state)
+    return state, err, state.commit - before

@@ -20,6 +20,10 @@ class ServerConfig:
     snap_count: int = 0
     cluster: Cluster = field(default_factory=Cluster)
     cluster_state: str = CLUSTER_STATE_NEW
+    # WAL-replay execution backend: "host" = sequential Python path,
+    # "tpu" = batched device replay (wal/replay_device.py), "auto" =
+    # device for large logs, host for small ones (compile latency).
+    storage_backend: str = "auto"
 
     def verify(self) -> None:
         """Reference config.go:24-43."""

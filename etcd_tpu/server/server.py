@@ -396,6 +396,38 @@ class EtcdServer:
         self.storage.cut()
 
 
+# In "auto" mode the batched device replay only pays off once the WAL
+# is big enough to amortize the jit compile (~seconds); below this the
+# sequential host path is faster.
+_DEVICE_REPLAY_MIN_BYTES = 8 << 20
+
+
+def _replay_wal(waldir: str, index: int, backend: str):
+    """WAL replay honoring --storage-backend (the north-star seam:
+    same (metadata, state, entries) out of either execution path)."""
+    if backend != "host":
+        size = sum(
+            os.path.getsize(os.path.join(waldir, f))
+            for f in os.listdir(waldir))
+        if backend == "tpu" or size >= _DEVICE_REPLAY_MIN_BYTES:
+            try:
+                from ..wal.replay_device import open_replay_device
+
+                w, md, hard_state, block = open_replay_device(
+                    waldir, index)
+                log.info("etcdserver: device replay of %d entries "
+                         "(%d bytes)", len(block), size)
+                return w, md, hard_state, block.entries()
+            except Exception:
+                if backend == "tpu":
+                    raise
+                log.warning("etcdserver: device replay failed; "
+                            "falling back to host path", exc_info=True)
+    w = WAL.open_at_index(waldir, index)
+    md, hard_state, ents = w.read_all()
+    return w, md, hard_state, ents
+
+
 def new_server(cfg: ServerConfig, *, discoverer=None,
                post_fn=None) -> EtcdServer:
     """Bootstrap/restart split (reference server.go:87-188)."""
@@ -441,8 +473,8 @@ def new_server(cfg: ServerConfig, *, discoverer=None,
                      snapshot.index)
             st.recovery(snapshot.data)
             index = snapshot.index
-        w = WAL.open_at_index(waldir, index)
-        md, hard_state, ents = w.read_all()
+        w, md, hard_state, ents = _replay_wal(
+            waldir, index, getattr(cfg, "storage_backend", "auto"))
         info = Info.unmarshal(md or b"")
         if info.id != m.id:
             raise RuntimeError(

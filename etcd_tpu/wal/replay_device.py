@@ -45,7 +45,9 @@ from .wal import (
     ENTRY_TYPE,
     METADATA_TYPE,
     STATE_TYPE,
+    WAL,
     check_wal_names,
+    is_valid_seq,
     search_index,
 )
 
@@ -66,6 +68,8 @@ class EntryBlock:
     data_off: np.ndarray   # uint64 [N] into blob
     data_len: np.ndarray   # uint64 [N]
     blob: np.ndarray       # uint8, the raw WAL byte stream
+    last_crc: int = 0      # stored CRC of the stream's final record
+                           # (seeds WAL.open_at_end for appending)
 
     def __len__(self) -> int:
         return self.index.size
@@ -127,8 +131,18 @@ def _pad_rows_numpy(blob, doff, dlen, width):
     return out
 
 
+def _width_class(w: int) -> int:
+    """Quantized row width: multiples of 128 up to 2 KiB, then powers
+    of two.  Bounds the set of compiled batch shapes (~27 lifetime)
+    while keeping padding waste small for the common record sizes."""
+    if w <= 2048:
+        return max(64, -(-w // 128) * 128)
+    return 1 << (w - 1).bit_length()
+
+
 def verify_chain_device(blob: np.ndarray, types, crcs, doff, dlen,
-                        batch: int = 1 << 17) -> None:
+                        chunk_rows: int = 1 << 17,
+                        byte_budget: int = 1 << 28) -> None:
     """Device-parallel rolling-chain verification of scanned records.
 
     Raises :class:`CRCMismatchError` naming the first bad record.
@@ -136,10 +150,16 @@ def verify_chain_device(blob: np.ndarray, types, crcs, doff, dlen,
     decoder rule of wal/wal.go:184-191 (a mid-file crc record instead
     participates as a regular zero-length link, which its stored value
     satisfies iff it matches the running chain — same check, batched).
-    """
-    from ..ops.crc_device import chain_verify_device, raw_crc_batch
 
-    n = types.shape[0]
+    Each link i depends only on the *stored* value of link i-1, so
+    verification is order-independent: records are grouped by width
+    class (so one huge record cannot inflate every row) and processed
+    in fixed-shape chunks (so each (width, rows) pair compiles once;
+    short tails are padded with trivially-true links).
+    """
+    from ..ops.crc_device import _chain_expected, raw_crc_batch
+
+    n = int(types.shape[0])
     if n == 0:
         return
     seed = 0
@@ -147,28 +167,57 @@ def verify_chain_device(blob: np.ndarray, types, crcs, doff, dlen,
     if types[0] == CRC_TYPE:
         seed = int(crcs[0])
         start = 1
-    width = max(8, int(dlen.max()) if n else 0)
-    width = -(-width // 64) * 64  # round up for tiling
-    bad: list[int] = []
-    chunk_seed = seed
-    for lo in range(start, n, batch):
-        hi = min(lo + batch, n)
-        if native.available():
-            rows = native.pad_rows(blob, doff[lo:hi], dlen[lo:hi], width)
-        else:
-            rows = _pad_rows_numpy(blob, doff[lo:hi], dlen[lo:hi], width)
-        raw = raw_crc_batch(rows)
-        ok = chain_verify_device(chunk_seed, crcs[lo:hi], raw,
-                                 dlen[lo:hi].astype(np.uint32))
-        ok = np.asarray(ok)
-        if not ok.all():
-            bad.append(lo + int(np.argmin(ok)))
-            break
-        chunk_seed = int(crcs[hi - 1])
-    if bad:
+    if start >= n:
+        return
+
+    stored = np.ascontiguousarray(crcs[start:], np.uint32)
+    prev = np.concatenate(
+        [np.asarray([seed], np.uint32), crcs[start:-1]])
+    doff_v = doff[start:]
+    dlen_v = np.ascontiguousarray(dlen[start:], np.uint64)
+
+    wcls = np.where(
+        dlen_v <= 2048,
+        np.maximum(64, -(-dlen_v.astype(np.int64) // 128) * 128),
+        np.int64(1) << np.ceil(
+            np.log2(np.maximum(dlen_v, 1).astype(np.float64))
+        ).astype(np.int64))
+
+    first_bad = None
+    for w in np.unique(wcls):
+        w = int(w)
+        rows_idx = np.nonzero(wcls == w)[0]
+        rpc = max(256, min(chunk_rows, byte_budget // w))
+        # don't build a mostly-padding chunk for a tiny class; pow2
+        # quantization keeps the compiled-shape count bounded
+        rpc = min(rpc, max(8, 1 << (rows_idx.size - 1).bit_length()))
+        for lo in range(0, rows_idx.size, rpc):
+            sel = rows_idx[lo:lo + rpc]
+            pad = rpc - sel.size
+            d_off = doff_v[sel]
+            d_len = dlen_v[sel]
+            st = stored[sel]
+            pv = prev[sel]
+            if pad:  # zero-length/zero-crc links are trivially true
+                d_off = np.pad(d_off, (0, pad))
+                d_len = np.pad(d_len, (0, pad))
+                st = np.pad(st, (0, pad))
+                pv = np.pad(pv, (0, pad))
+            if native.available():
+                rows = native.pad_rows(blob, d_off, d_len, w)
+            else:
+                rows = _pad_rows_numpy(blob, d_off, d_len, w)
+            raw = raw_crc_batch(rows)
+            ok = np.asarray(
+                _chain_expected(pv, raw, d_len.astype(np.uint32)) == st)
+            if not ok.all():
+                bad = start + int(sel[np.argmin(ok[:sel.size])])
+                if first_bad is None or bad < first_bad:
+                    first_bad = bad
+    if first_bad is not None:
         raise CRCMismatchError(
-            f"crc chain broken at record {bad[0]} "
-            f"(stored={int(crcs[bad[0]]):#x})")
+            f"crc chain broken at record {first_bad} "
+            f"(stored={int(crcs[first_bad]):#x})")
 
 
 def read_all_device(dirpath: str, index: int = 0
@@ -187,7 +236,7 @@ def read_all_device(dirpath: str, index: int = 0
     if not names:
         raise FileNotFoundError_(dirpath)
     i = search_index(names, index)
-    if i is None:
+    if i is None or not is_valid_seq(names[i:]):
         raise FileNotFoundError_(f"no wal file covers index {index}")
     names = names[i:]
 
@@ -199,6 +248,12 @@ def read_all_device(dirpath: str, index: int = 0
         types, crcs, doff, dlen, eidx, eterm, etype = native.wal_scan(blob)
     else:
         types, crcs, doff, dlen, eidx, eterm, etype = _scan_python(blob)
+
+    known = np.isin(types, (METADATA_TYPE, ENTRY_TYPE, STATE_TYPE,
+                            CRC_TYPE))
+    if not known.all():
+        j = int(np.argmin(known))
+        raise WALError(f"unexpected block type {int(types[j])}")
 
     verify_chain_device(blob, types, crcs, doff, dlen)
 
@@ -253,5 +308,21 @@ def read_all_device(dirpath: str, index: int = 0
 
     block = EntryBlock(
         index=eidx[sel], term=eterm[sel], type=etype[sel],
-        data_off=doff[sel], data_len=dlen[sel], blob=blob)
+        data_off=doff[sel], data_len=dlen[sel], blob=blob,
+        last_crc=int(crcs[-1]) if crcs.size else 0)
     return metadata, state, block
+
+
+def open_replay_device(dirpath: str, index: int = 0
+                       ) -> tuple[WAL, bytes | None, HardState, EntryBlock]:
+    """Replay on device, then open the WAL for appending.
+
+    The device-backed equivalent of ``open_at_index + read_all``: the
+    batched pass both verifies the stream and yields the chain tail
+    CRC, so the append encoder seeds directly (WAL.open_at_end) with
+    no sequential re-read.
+    """
+    metadata, state, block = read_all_device(dirpath, index)
+    enti = int(block.index[-1]) if len(block) else 0
+    w = WAL.open_at_end(dirpath, metadata, block.last_crc, enti)
+    return w, metadata, state, block

@@ -217,6 +217,31 @@ class WAL:
         return w
 
     @classmethod
+    def open_at_end(cls, dirpath: str, metadata: bytes | None,
+                    last_crc: int, enti: int) -> "WAL":
+        """Open directly in append mode, seeding the encoder's rolling
+        CRC with ``last_crc`` (the stored CRC of the final record).
+
+        Companion to the device replay path (replay_device.py), which
+        verifies and decodes the whole stream in one batched pass and
+        already knows the chain tail — so the read-then-append
+        lifecycle of ``open_at_index`` + ``read_all`` is unnecessary.
+        """
+        names = sorted(check_wal_names(os.listdir(dirpath)))
+        if not names:
+            raise FileNotFoundError_(dirpath)
+        seq, _ = parse_wal_name(names[-1])
+        f = _open_append_0600(os.path.join(dirpath, names[-1]))
+        w = cls()
+        w.dir = dirpath
+        w.md = metadata
+        w.seq = seq
+        w.f = f
+        w.enti = enti
+        w.encoder = _Encoder(f, last_crc)
+        return w
+
+    @classmethod
     def open_at_index(cls, dirpath: str, index: int) -> "WAL":
         """Open read-mode at ``index``; the caller must ``read_all``
         before appending (reference wal/wal.go:108-159)."""

@@ -92,3 +92,42 @@ def test_shard_leading_placement():
     mesh = group_mesh(8)
     x = shard_leading(mesh, np.zeros((8, 4), np.int32))
     assert x.sharding.mesh.shape == mesh.shape
+
+
+def test_sharded_data_plane_step_matches_local():
+    import jax
+    import jax.numpy as jnp
+    from etcd_tpu.parallel import data_plane_step, make_sharded_step
+    from etcd_tpu.raft.batched import LEADER, init_groups
+
+    rng = np.random.default_rng(11)
+    n, max_len = 16, 24
+    g, m, cap = 8, 3, 16
+    buf, lens, stored, seed = _mk_records(n, max_len, rng)
+    state = init_groups(g, m, cap)
+    state = state._replace(role=jnp.full((g,), LEADER, jnp.int32),
+                           term=jnp.ones((g,), jnp.int32))
+    n_new = np.full(g, 2, np.int32)
+    self_slot = np.zeros(g, np.int32)
+    resp_slots = np.tile(np.asarray([[1, 2]], np.int32), (g, 1))
+    resp_idx = np.full((g, 2), 2, np.int32)
+    resp_mask = np.ones((g, 2), bool)
+
+    ok_l, st_l, err_l, nc_l = jax.jit(data_plane_step)(
+        buf, lens, stored, np.uint32(seed), state, n_new, self_slot,
+        resp_slots, resp_idx, resp_mask)
+    assert bool(np.all(np.asarray(ok_l)))
+    assert not np.asarray(err_l).any()
+    np.testing.assert_array_equal(np.asarray(nc_l), 2)
+
+    mesh = group_mesh(8)
+    step = make_sharded_step(mesh)
+    ok_s, st_s, err_s, nc_s, commit_all = step(
+        buf, lens, stored, seed, state, n_new, self_slot,
+        resp_slots, resp_idx, resp_mask)
+    np.testing.assert_array_equal(np.asarray(ok_s), np.asarray(ok_l))
+    np.testing.assert_array_equal(np.asarray(nc_s), np.asarray(nc_l))
+    np.testing.assert_array_equal(np.asarray(commit_all),
+                                  np.asarray(st_l.commit))
+    for a, b in zip(jax.tree.leaves(st_s), jax.tree.leaves(st_l)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -136,3 +136,86 @@ def test_python_scan_negative_length(monkeypatch):
     bad = np.frombuffer(struct.pack("<q", -8), dtype=np.uint8).copy()
     with pytest.raises(WALError, match="truncated"):
         _scan_python(bad)
+
+
+def test_open_replay_device_append_continuation(tmp_path):
+    """Appending after a device replay keeps the rolling chain valid
+    for a subsequent host read_all AND native replay."""
+    d = tmp_path / "wal"
+    _write_wal(d, n_entries=10, cuts=(5,))
+    from etcd_tpu.wal.replay_device import open_replay_device
+    w, md, st, block = open_replay_device(str(d), 0)
+    assert md == b"meta-bytes"
+    w.save(HardState(term=3, vote=1, commit=11),
+           [Entry(term=3, index=10, data=b"post-device-1"),
+            Entry(term=3, index=11, data=b"post-device-2")])
+    w.cut()  # exercise segment-roll with the seeded chain
+    w.save_entry(Entry(term=3, index=12, data=b"post-cut"))
+    w.sync()
+    w.close()
+    w2 = WAL.open_at_index(str(d), 0)
+    _, st2, ents = w2.read_all()
+    w2.close()
+    assert [e.index for e in ents][-3:] == [10, 11, 12]
+    assert st2.commit == 11
+    # device re-replay agrees too
+    _, st3, block3 = read_all_device(str(d), 0)
+    assert int(block3.index[-1]) == 12
+
+
+def test_server_restart_tpu_backend(tmp_path):
+    """new_server(--storage-backend=tpu) restores identical state."""
+    from etcd_tpu.server.server import _replay_wal
+    d = tmp_path / "wal"
+    _write_wal(d, n_entries=12, cuts=(6,))
+    w_h = WAL.open_at_index(str(d), 0)
+    md_h, st_h, ents_h = w_h.read_all()
+    w_h.close()
+    w, md, st, ents = _replay_wal(str(d), 0, "tpu")
+    try:
+        assert md == md_h
+        assert (st.term, st.vote, st.commit) == \
+            (st_h.term, st_h.vote, st_h.commit)
+        assert [(e.index, e.term, e.data) for e in ents] == \
+            [(e.index, e.term, e.data) for e in ents_h]
+    finally:
+        w.close()
+
+
+def test_unknown_record_type_rejected(tmp_path):
+    """Parity with WAL.read_all's 'unexpected block type' error."""
+    from etcd_tpu.wal.wal import _Encoder
+    from etcd_tpu.wire import Record
+    d = tmp_path / "wal"
+    _write_wal(d, n_entries=3, cuts=())
+    fname = sorted(os.listdir(d))[0]
+    # append a validly-chained record of unknown type 9
+    blob = np.fromfile(d / fname, dtype=np.uint8)
+    types, crcs, doff, dlen, _, _, _ = native.wal_scan(blob)
+    with open(d / fname, "ab") as f:
+        enc = _Encoder(f, int(crcs[-1]))
+        enc.encode(Record(type=9, data=b"future"))
+    from etcd_tpu.wal.errors import WALError
+    with pytest.raises(WALError, match="unexpected block type 9"):
+        read_all_device(str(d), 0)
+    w = WAL.open_at_index(str(d), 0)
+    with pytest.raises(WALError, match="unexpected block type 9"):
+        w.read_all()
+    w.close()
+
+
+def test_mixed_width_records(tmp_path):
+    """One huge record must not inflate every row's padding: width
+    classes keep the batch allocatable and the chain still verifies."""
+    d = tmp_path / "wal"
+    w = WAL.create(str(d), b"m")
+    for i in range(50):
+        w.save_entry(Entry(term=1, index=i, data=b"s" * 16))
+    w.save_entry(Entry(term=1, index=50, data=b"L" * 50000))
+    for i in range(51, 60):
+        w.save_entry(Entry(term=1, index=i, data=b"t" * 24))
+    w.sync()
+    w.close()
+    _, _, block = read_all_device(str(d), 0)
+    assert len(block) == 60
+    assert int(block.data_len[50]) > 50000
