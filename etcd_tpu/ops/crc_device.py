@@ -154,11 +154,12 @@ def raw_crc_batch(buf, use_pallas: bool | None = None) -> jnp.ndarray:
 def shift_crc_batch(states: jnp.ndarray, lens: jnp.ndarray) -> jnp.ndarray:
     """``Z^lens[i] @ states[i]`` elementwise: uint32 [N].
 
-    Loops over the bits of ``lens`` (static 30-iteration bound covers
-    lengths up to 1 GiB) with masked [N,32]@[32,32] parity matmuls —
-    the device form of gf2.combine_batch.
+    Loops over the bits of ``lens`` (static 32-iteration bound: the
+    full uint32 range, i.e. shifts up to 4 GiB - 1) with masked
+    [N,32]@[32,32] parity matmuls — the device form of
+    gf2.combine_batch.
     """
-    nbits = 30
+    nbits = 32
     zp = jnp.asarray(_zpow_stack(nbits))  # [nbits, 32, 32] int8
     bits = _to_bits32(jnp.asarray(states, dtype=jnp.uint32))  # [N, 32]
     lens = jnp.asarray(lens, dtype=jnp.uint32)

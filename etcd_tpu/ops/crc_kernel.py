@@ -1,0 +1,117 @@
+"""Whole-blob CRC32C on device (north-star config 3).
+
+The reference hashes snapshot blobs with one sequential pass
+(snap/snapshotter.go:53,98 — ``crc32.Update`` over the whole file).
+Here the blob is split into fixed chunks and the sequential dependency
+collapses via linearity over GF(2):
+
+    raw(c_0 ++ ... ++ c_{K-1}) = XOR_k  Z^suffix_k @ raw(c_k)
+
+where ``suffix_k`` is the byte count after chunk k.  Every chunk's raw
+CRC state is one row of a batched MXU bit-matmul (ops/crc_device.py),
+the ``Z^suffix`` shifts run as batched masked matmuls
+(shift_crc_batch), and the XOR-reduce is a bit-parity sum — all on
+device; only the final 32-bit fix-up happens on host.  This is the
+snapshot-hash analog of the blockwise-parallel WAL chain (SURVEY §5.7).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..crc import crc32c as _host
+from ..crc import gf2
+from .crc_device import (
+    _from_bits32,
+    _to_bits32,
+    raw_crc_batch,
+    shift_crc_batch,
+)
+
+_MASK32 = 0xFFFFFFFF
+
+# Below this size the sequential host path wins (device dispatch +
+# transfer latency); above it the batched path amortizes.
+DEVICE_MIN_BYTES = 4 << 20
+# Chunk width: the [8*CHUNK, 32] contribution matrix (1 MiB at 4 KiB
+# chunks) must fit VMEM beside the per-tile bit expansion, and builds
+# in O(CHUNK) host work once per process (lru-cached).
+CHUNK = 1 << 12
+# Rows dispatched per device call: bounds the XLA-path bit expansion
+# ([ROWS, 8*CHUNK] = 1 GiB at these defaults) and H2D staging.
+ROW_BATCH = 1 << 15
+
+
+def _xor_reduce(states: jnp.ndarray) -> jnp.ndarray:
+    """XOR over a [K] uint32 vector = per-bit parity sum."""
+    bits = _to_bits32(states)  # [K, 32] int8
+    return _from_bits32(jnp.sum(bits.astype(jnp.int32), axis=0) & 1)
+
+
+def device_crc32c(data, chunk: int = CHUNK) -> int:
+    """``crc32.Update(0, castagnoli, data)`` via batched device chunks.
+
+    Bit-identical to the host path (crc/crc32c.py:value) for any
+    length, including zero and non-chunk-multiple tails.
+    """
+    buf = np.frombuffer(memoryview(data), dtype=np.uint8) \
+        if not isinstance(data, np.ndarray) else data
+    n = int(buf.size)
+    if n == 0:
+        return 0
+    if n >= 1 << 32:  # suffix shifts are uint32 (4 GiB ceiling)
+        return _host.value(buf)
+    k = -(-n // chunk)
+    rem = n - (k - 1) * chunk
+    # Chunk 0 is the (possibly short) head; right-alignment makes its
+    # leading zero-padding free for a zero raw state.
+    head = np.zeros((1, chunk), np.uint8)
+    head[0, chunk - rem:] = buf[:rem]
+    body = buf[rem:].reshape(k - 1, chunk) if k > 1 else \
+        np.zeros((0, chunk), np.uint8)
+
+    raw_parts = [np.asarray(raw_crc_batch(head), np.uint32)]
+    for lo in range(0, k - 1, ROW_BATCH):
+        part = body[lo:lo + ROW_BATCH]
+        np_rows = part.shape[0]
+        # pad partial batches to a power of two: bounded compiled
+        # shapes instead of one per blob size (zero rows are dropped)
+        pad_to = 1 << max(0, (np_rows - 1).bit_length())
+        if pad_to != np_rows:
+            part = np.vstack(
+                [part, np.zeros((pad_to - np_rows, chunk), np.uint8)])
+        raw_parts.append(np.asarray(
+            raw_crc_batch(part), np.uint32)[:np_rows])
+    raws = np.concatenate(raw_parts)
+
+    suffix = (np.arange(k - 1, -1, -1, dtype=np.int64) * chunk)
+    shifted = shift_crc_batch(jnp.asarray(raws),
+                              jnp.asarray(suffix, jnp.uint32))
+    total = int(_xor_reduce(shifted))
+
+    # Go convention: update(0, m) = Z^n @ ~0 ^ raw(m) ^ ~0
+    inv = gf2.matvec(gf2.zero_operator(n), _MASK32)
+    return (total ^ inv ^ _MASK32) & _MASK32
+
+
+def auto_crc32c(data) -> int:
+    """Host CRC for small blobs, device path for large ones — the
+    drop-in ``crc_fn`` for snap.Snapshotter.
+
+    Device/runtime failures degrade to the host path rather than
+    escaping: Snapshotter.load's quarantine logic only understands
+    SnapError, and a transient device fault must not look like
+    snapshot corruption (snap/snapshotter.go:62-74 semantics).
+    """
+    n = len(data) if not isinstance(data, np.ndarray) else data.size
+    if n < DEVICE_MIN_BYTES:
+        return _host.value(data)
+    try:
+        return device_crc32c(data)
+    except Exception:  # pragma: no cover - device-env specific
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "device crc failed; host fallback", exc_info=True)
+        return _host.value(data)

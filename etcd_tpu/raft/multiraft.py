@@ -1,0 +1,262 @@
+"""Co-hosted multi-raft runtime: G groups × M members, batched.
+
+The reference hosts ONE raft group per process and tests multi-node
+behavior with an in-process fake network pump (raft_test.go:1203-1263).
+This runtime is the batched generalization: member ``m`` of *every*
+group lives in one ``GroupState`` batch (arrays [G]), so a full
+M-member cluster of G co-hosted groups is M pytrees, and "message
+delivery" between co-hosted members is array exchange — no
+serialization, no sockets (SURVEY §5.8: intra-slice communication is
+sharded-array collectives; inter-member DCN transport stays at the
+server layer for cross-host peers).
+
+The hot path (propose → replicate → respond → commit) runs entirely
+as batched device ops (raft/batched.py); elections run batched too
+(grant_vote quorum across members), fired by the batched tick timers.
+
+Payload bytes stay host-side (a per-group ring keyed by log index —
+the wrong shape for HBM), mirroring the split in SURVEY §7: the
+device owns index/term/commit math, the host owns opaque blobs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .batched import (
+    CANDIDATE,
+    FOLLOWER,
+    LEADER,
+    GroupState,
+    grant_vote,
+    init_groups,
+    leader_append,
+    maybe_append,
+    maybe_commit,
+    progress_update,
+    term_at,
+    tick as tick_batch,
+)
+
+
+class MultiRaft:
+    """G co-hosted groups, M members each, batched across groups."""
+
+    def __init__(self, g: int, m: int, cap: int, election: int = 10,
+                 max_batch_ents: int = 8, seed: int = 0):
+        self.g, self.m, self.cap = g, m, cap
+        self.e = max_batch_ents
+        rng = np.random.default_rng(seed)
+        self.states: list[GroupState] = []
+        for slot in range(m):
+            st = init_groups(g, m, cap, election=election)
+            # randomized election timeouts (raft.go:611-617): each
+            # member draws [election, 2*election) per group
+            st = st._replace(timeout=jnp.asarray(
+                rng.integers(election, 2 * election, size=g), jnp.int32))
+            self.states.append(st)
+        self.leader = np.full(g, -1, np.int32)  # member slot per group
+        # host-side payload store: per-group dict index -> bytes
+        self.payloads: list[dict[int, bytes]] = [dict() for _ in range(g)]
+
+    # -- elections (batched across groups) ------------------------------
+
+    def campaign(self, slot: int, mask: np.ndarray | None = None
+                 ) -> np.ndarray:
+        """Member ``slot`` campaigns for the masked groups
+        (raft.go:358-370 batched): term+1, vote self, request votes
+        from every other member, count the quorum.
+
+        Returns the [G] bool mask of groups where it won.
+        """
+        g, m = self.g, self.m
+        mask = np.ones(g, bool) if mask is None else mask
+        mj = jnp.asarray(mask)
+        cand = self.states[slot]
+        new_term = cand.term + mj.astype(jnp.int32)
+        cand = cand._replace(
+            term=new_term,
+            role=jnp.where(mj, CANDIDATE, cand.role),
+            vote=jnp.where(mj, slot, cand.vote))
+
+        votes = np.ones(g, np.int64)  # own vote
+        cand_last = cand.last
+        cand_lterm = term_at(cand.log_term, cand.offset, cand.last,
+                             cand.last)
+        for peer in range(m):
+            if peer == slot:
+                continue
+            st = self.states[peer]
+            # msgVote carries the candidate term; peers at a lower
+            # term adopt it (raft.go:388-396 batched)
+            adopt = mj & (cand.term > st.term)
+            st = st._replace(
+                term=jnp.where(adopt, cand.term, st.term),
+                vote=jnp.where(adopt, -1, st.vote),
+                role=jnp.where(adopt, FOLLOWER, st.role))
+            st, granted = grant_vote(
+                st, cand_last, cand_lterm, cand.term,
+                jnp.full((g,), slot, jnp.int32), active=mj)
+            # granting a vote resets the election timer (the reference
+            # resets on any message from a legitimate candidate)
+            st = st._replace(elapsed=jnp.where(granted, 0, st.elapsed))
+            self.states[peer] = st
+            votes += np.asarray(granted).astype(np.int64)
+
+        won = mask & (votes >= (m // 2 + 1))
+        wj = jnp.asarray(won)
+        # winners become leader; note the reference appends an empty
+        # entry on becoming leader (raft.go:329-348) so the new term
+        # has a committable entry — replicated via the normal path
+        cand = cand._replace(
+            role=jnp.where(wj, LEADER, cand.role),
+            lead=jnp.where(wj, slot, cand.lead),
+            match=jnp.where(wj[:, None], 0, cand.match),
+            next_=jnp.where(wj[:, None], cand.last[:, None] + 1,
+                            cand.next_))
+        self.states[slot] = cand
+        won_np = np.asarray(wj)
+        self.leader = np.where(won_np, slot, self.leader).astype(np.int32)
+        if won_np.any():
+            # the becoming-leader empty entry (raft.go:329-348)
+            self.propose(np.where(won_np, 1, 0).astype(np.int32))
+        return won_np
+
+    # -- the replication hot path ---------------------------------------
+
+    def propose(self, n_new: np.ndarray,
+                data: list[list[bytes]] | None = None) -> np.ndarray:
+        """Append ``n_new[g]`` proposals to each group's leader and
+        run one full replicate→respond→commit round.  Returns the
+        per-group count of newly committed entries."""
+        g, m = self.g, self.m
+        lead = self.leader
+        n_new = np.asarray(n_new, np.int32)
+
+        # capture append bases from members that really ARE leader
+        # (a deposed member may still be in self.leader briefly)
+        valid = np.zeros(g, bool)
+        base = np.zeros(g, np.int64)
+        for slot in range(m):
+            sel = lead == slot
+            if not sel.any():
+                continue
+            st = self.states[slot]
+            is_lead = sel & (np.asarray(st.role) == LEADER)
+            valid |= is_lead
+            base[is_lead] = np.asarray(st.last)[is_lead]
+
+        for slot in range(m):
+            sel = jnp.asarray(lead == slot)
+            if not bool(np.asarray(sel).any()):
+                continue
+            st = self.states[slot]
+            st, err = leader_append(
+                st, jnp.where(sel, jnp.asarray(n_new), 0),
+                jnp.full((g,), slot, jnp.int32), active=sel)
+            if bool(np.asarray(err).any()):
+                raise OverflowError("log capacity exceeded; compact")
+            self.states[slot] = st
+
+        # payloads recorded only after the appends landed, keyed from
+        # the validated leader's pre-append last index
+        if data is not None:
+            for gi in np.nonzero(valid)[0]:
+                for j, blob in enumerate(data[gi][:int(n_new[gi])]):
+                    self.payloads[gi][int(base[gi]) + 1 + j] = blob
+        return self.replicate()
+
+    def replicate(self) -> np.ndarray:
+        """One replication round for every group: leaders send their
+        pending window to every follower member, absorb the responses,
+        advance the quorum commit (the batched §3.2 inner loop)."""
+        g, m, e = self.g, self.m, self.e
+        commits_before = self._commit_vector()
+
+        for slot in range(m):
+            sel_np = self.leader == slot
+            if not sel_np.any():
+                continue
+            sel = jnp.asarray(sel_np)
+            lst = self.states[slot]
+            for peer in range(m):
+                if peer == slot:
+                    continue
+                pst = self.states[peer]
+                # window: follower's next.. min(next+E-1, leader last)
+                nxt = jnp.take_along_axis(
+                    lst.next_, jnp.full((g, 1), peer, jnp.int32),
+                    axis=1)[:, 0]
+                # followers at a lower term adopt the leader's
+                # (raft.go:388-396); stale leaders don't send
+                send = sel & (lst.term >= pst.term) & \
+                    (lst.role == LEADER)
+                adopt = send & (lst.term > pst.term)
+                pst = pst._replace(
+                    term=jnp.where(adopt, lst.term, pst.term),
+                    vote=jnp.where(adopt, -1, pst.vote),
+                    role=jnp.where(send, FOLLOWER, pst.role),
+                    lead=jnp.where(send, slot, pst.lead))
+                prev_idx = nxt - 1
+                prev_term = term_at(lst.log_term, lst.offset, lst.last,
+                                    prev_idx)
+                n_send = jnp.clip(lst.last - prev_idx, 0, e)
+                ent_idx = prev_idx[:, None] + 1 + \
+                    jnp.arange(e, dtype=jnp.int32)
+                ent_terms = term_at(lst.log_term, lst.offset, lst.last,
+                                    ent_idx)
+                pst, ok, err = maybe_append(
+                    pst, prev_idx, prev_term, ent_terms, n_send,
+                    lst.commit, active=send)
+                if bool(np.asarray(err).any()):
+                    raise RuntimeError("append conflict below commit")
+                # any append from the legitimate leader resets the
+                # follower's election timer (otherwise every follower
+                # would depose a healthy leader each `timeout` ticks)
+                pst = pst._replace(
+                    elapsed=jnp.where(send, 0, pst.elapsed))
+                self.states[peer] = pst
+                # msgAppResp: success → progress update; reject →
+                # decrement next (raft.go:464-470 batched)
+                acked = prev_idx + n_send
+                lst = progress_update(lst, jnp.full((g,), peer,
+                                                    jnp.int32),
+                                      acked, active=send & ok)
+                reject = send & ~ok
+                if bool(np.asarray(reject).any()):
+                    onehot = jnp.arange(m) == peer
+                    dec = jnp.maximum(nxt - 1, 1)
+                    lst = lst._replace(next_=jnp.where(
+                        reject[:, None] & onehot[None, :],
+                        dec[:, None], lst.next_))
+            lst = maybe_commit(lst)
+            self.states[slot] = lst
+        return self._commit_vector() - commits_before
+
+    def tick(self) -> None:
+        """Advance every member's timers; campaign where they fire."""
+        for slot in range(self.m):
+            st, elect, _beat = tick_batch(self.states[slot])
+            self.states[slot] = st
+            fire = np.asarray(elect)
+            if fire.any():
+                self.campaign(slot, fire)
+
+    # -- views -----------------------------------------------------------
+
+    def _commit_vector(self) -> np.ndarray:
+        """Max commit across members per group (any member's commit
+        is authoritative once set)."""
+        return np.max(np.stack(
+            [np.asarray(st.commit) for st in self.states]), axis=0)
+
+    def commit_index(self) -> np.ndarray:
+        return self._commit_vector()
+
+    def committed_payload(self, group: int, index: int) -> bytes | None:
+        return self.payloads[group].get(index)
+
+    def log_terms(self, slot: int) -> np.ndarray:
+        return np.asarray(self.states[slot].log_term)

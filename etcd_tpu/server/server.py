@@ -434,7 +434,16 @@ def new_server(cfg: ServerConfig, *, discoverer=None,
     cfg.verify()
     snapdir = os.path.join(cfg.data_dir, "snap")
     os.makedirs(snapdir, mode=0o700, exist_ok=True)
-    ss = Snapshotter(snapdir)
+    crc_fn = None
+    if getattr(cfg, "storage_backend", "auto") != "host":
+        try:  # device hash for large snapshot blobs; host otherwise
+            from ..ops.crc_kernel import auto_crc32c
+
+            crc_fn = auto_crc32c
+        except ImportError:
+            log.warning("etcdserver: jax unavailable; host snapshot "
+                        "hashing")
+    ss = Snapshotter(snapdir, crc_fn=crc_fn)
     st = Store()
     m = cfg.cluster.find_name(cfg.name)
     waldir = os.path.join(cfg.data_dir, "wal")

@@ -1,0 +1,49 @@
+"""Device whole-blob CRC vs the host oracle (north-star config 3)."""
+
+import numpy as np
+import pytest
+
+from etcd_tpu.crc import crc32c
+from etcd_tpu.ops import crc_kernel
+from etcd_tpu.ops.crc_kernel import auto_crc32c, device_crc32c
+
+
+@pytest.mark.parametrize("n", [0, 1, 7, 100, 4096, 4097, 10000, 70000])
+def test_device_crc_parity(n):
+    rng = np.random.default_rng(n)
+    data = rng.integers(0, 256, size=n).astype(np.uint8)
+    assert device_crc32c(data, chunk=4096) == crc32c.value(data)
+
+
+def test_device_crc_small_chunks_many_batches():
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, size=50000).astype(np.uint8)
+    # tiny chunk + tiny row batch: exercises head/pow2-pad/multi-batch
+    old = crc_kernel.ROW_BATCH
+    crc_kernel.ROW_BATCH = 4
+    try:
+        assert device_crc32c(data, chunk=512) == crc32c.value(data)
+    finally:
+        crc_kernel.ROW_BATCH = old
+
+
+def test_auto_dispatch():
+    small = b"abc" * 100
+    assert auto_crc32c(small) == crc32c.value(small)
+
+
+def test_snapshotter_with_device_hash(tmp_path):
+    from etcd_tpu.snap import Snapshotter
+    from etcd_tpu.wire import Snapshot
+
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 256, size=20000).astype(np.uint8).tobytes()
+    s = Snapshotter(str(tmp_path),
+                    crc_fn=lambda b: device_crc32c(b, chunk=1024))
+    s.save_snap(Snapshot(data=data, nodes=[1, 2, 3], index=7, term=2))
+    # host-hashing loader verifies the device-written crc and back
+    s_host = Snapshotter(str(tmp_path))
+    got = s_host.load()
+    assert got.data == data and got.index == 7
+    got2 = s.load()  # device-hashing loader verifies host semantics
+    assert got2.data == data
