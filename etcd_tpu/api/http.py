@@ -36,6 +36,7 @@ log = logging.getLogger(__name__)
 
 KEYS_PREFIX = "/v2/keys"
 MACHINES_PREFIX = "/v2/machines"
+STATS_PREFIX = "/v2/stats"
 RAFT_PREFIX = "/raft"
 
 DEFAULT_SERVER_TIMEOUT = 0.5  # reference http.go:29
@@ -232,6 +233,8 @@ class EtcdRequestHandler(BaseHTTPRequestHandler):
                 return
             if path == MACHINES_PREFIX:
                 self._serve_machines(method)
+            elif path.startswith(STATS_PREFIX):
+                self._serve_stats(method, path)
             elif path.startswith(KEYS_PREFIX):
                 self._serve_keys(method)
             else:
@@ -317,6 +320,26 @@ class EtcdRequestHandler(BaseHTTPRequestHandler):
             self._handle_watch(resp.watcher, rr.stream)
         else:  # pragma: no cover
             self._write_error(RuntimeError("no event/watcher"))
+
+    def _serve_stats(self, method: str, path: str) -> None:
+        """/v2/stats/{self,store,leader} — observability endpoints
+        (new work per SURVEY §5.5: the 0.5-alpha reference collects
+        store counters but never wires an HTTP stats route)."""
+        if method != "GET":
+            self._reply(405, b"Method Not Allowed\n", {"Allow": "GET"})
+            return
+        sub = path[len(STATS_PREFIX):].strip("/")
+        if sub == "store":
+            body = self.etcd.store.json_stats()
+        elif sub == "self":
+            body = self.etcd.server_stats.to_json()
+        elif sub == "leader":
+            body = self.etcd.leader_stats.to_json()
+        else:
+            self._reply(404, b"404 page not found\n")
+            return
+        self._reply(200, body,
+                    {"Content-Type": "application/json"})
 
     def _serve_machines(self, method: str) -> None:
         """Reference serveMachines (http.go:111-117)."""

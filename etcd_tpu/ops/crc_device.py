@@ -94,17 +94,22 @@ def _invert_table(max_len: int) -> np.ndarray:
 
 # -- device bit helpers ------------------------------------------------------
 
-_BIT32 = jnp.arange(32, dtype=jnp.uint32)
+# NB: no module-level jnp arrays — they would initialize a JAX
+# backend at import time, which hangs server boot when the device
+# plugin is unreachable (the server imports this module lazily for
+# the crc_fn seam). jnp.arange inside traced code constant-folds.
 
 
 def _to_bits32(x: jnp.ndarray) -> jnp.ndarray:
     """uint32 [...,] -> int8 bits [..., 32] (LSB first)."""
-    return ((x[..., None] >> _BIT32) & jnp.uint32(1)).astype(jnp.int8)
+    bit32 = jnp.arange(32, dtype=jnp.uint32)
+    return ((x[..., None] >> bit32) & jnp.uint32(1)).astype(jnp.int8)
 
 
 def _from_bits32(bits: jnp.ndarray) -> jnp.ndarray:
     """int32/int8 0-1 bits [..., 32] -> uint32 [...]."""
-    return jnp.sum(bits.astype(jnp.uint32) << _BIT32, axis=-1,
+    bit32 = jnp.arange(32, dtype=jnp.uint32)
+    return jnp.sum(bits.astype(jnp.uint32) << bit32, axis=-1,
                    dtype=jnp.uint32)
 
 

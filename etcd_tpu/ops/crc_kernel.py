@@ -86,6 +86,14 @@ def device_crc32c(data, chunk: int = CHUNK) -> int:
     raws = np.concatenate(raw_parts)
 
     suffix = (np.arange(k - 1, -1, -1, dtype=np.int64) * chunk)
+    # pad the fold to a power of two as well — zero states shift to
+    # zero and XOR away, and the compile cache stays bounded instead
+    # of recompiling per distinct chunk count
+    k_pad = 1 << max(0, (k - 1).bit_length())
+    if k_pad != k:
+        raws = np.concatenate([raws, np.zeros(k_pad - k, np.uint32)])
+        suffix = np.concatenate([suffix,
+                                 np.zeros(k_pad - k, np.int64)])
     shifted = shift_crc_batch(jnp.asarray(raws),
                               jnp.asarray(suffix, jnp.uint32))
     total = int(_xor_reduce(shifted))

@@ -120,6 +120,16 @@ class MultiRaft:
         won_np = np.asarray(wj)
         self.leader = np.where(won_np, slot, self.leader).astype(np.int32)
         if won_np.any():
+            # Entries beyond the winner's last were never committed
+            # (Raft safety: committed entries survive elections), so a
+            # deposed leader's payloads at those indices are garbage
+            # the new term may overwrite — drop them.
+            winner_last = np.asarray(cand.last)
+            for gi in np.nonzero(won_np)[0]:
+                cut = int(winner_last[gi])
+                self.payloads[gi] = {
+                    k: v for k, v in self.payloads[gi].items()
+                    if k <= cut}
             # the becoming-leader empty entry (raft.go:329-348)
             self.propose(np.where(won_np, 1, 0).astype(np.int32))
         return won_np
@@ -127,7 +137,8 @@ class MultiRaft:
     # -- the replication hot path ---------------------------------------
 
     def propose(self, n_new: np.ndarray,
-                data: list[list[bytes]] | None = None) -> np.ndarray:
+                data: list[list[bytes]] | None = None,
+                drop=None) -> np.ndarray:
         """Append ``n_new[g]`` proposals to each group's leader and
         run one full replicate→respond→commit round.  Returns the
         per-group count of newly committed entries."""
@@ -166,13 +177,21 @@ class MultiRaft:
             for gi in np.nonzero(valid)[0]:
                 for j, blob in enumerate(data[gi][:int(n_new[gi])]):
                     self.payloads[gi][int(base[gi]) + 1 + j] = blob
-        return self.replicate()
+        return self.replicate(drop=drop)
 
-    def replicate(self) -> np.ndarray:
+    def replicate(self, drop=None) -> np.ndarray:
         """One replication round for every group: leaders send their
         pending window to every follower member, absorb the responses,
-        advance the quorum commit (the batched §3.2 inner loop)."""
+        advance the quorum commit (the batched §3.2 inner loop).
+
+        ``drop``: optional fault-injection mask — ``drop[(a, b)]`` is a
+        [G] bool array dropping messages from member a to member b for
+        the masked groups, the batched analog of the reference's
+        per-edge lossy fake network (raft_test.go:1258-1287).  Dropped
+        appends are simply retried on a later round: the protocol's
+        fire-and-forget contract (server.go:202-206)."""
         g, m, e = self.g, self.m, self.e
+        drop = drop or {}
         commits_before = self._commit_vector()
 
         for slot in range(m):
@@ -193,6 +212,8 @@ class MultiRaft:
                 # (raft.go:388-396); stale leaders don't send
                 send = sel & (lst.term >= pst.term) & \
                     (lst.role == LEADER)
+                if (slot, peer) in drop:
+                    send = send & ~jnp.asarray(drop[(slot, peer)])
                 adopt = send & (lst.term > pst.term)
                 pst = pst._replace(
                     term=jnp.where(adopt, lst.term, pst.term),
@@ -219,12 +240,16 @@ class MultiRaft:
                     elapsed=jnp.where(send, 0, pst.elapsed))
                 self.states[peer] = pst
                 # msgAppResp: success → progress update; reject →
-                # decrement next (raft.go:464-470 batched)
+                # decrement next (raft.go:464-470 batched); the
+                # response direction can be dropped independently
+                resp_ok = send
+                if (peer, slot) in drop:
+                    resp_ok = resp_ok & ~jnp.asarray(drop[(peer, slot)])
                 acked = prev_idx + n_send
                 lst = progress_update(lst, jnp.full((g,), peer,
                                                     jnp.int32),
-                                      acked, active=send & ok)
-                reject = send & ~ok
+                                      acked, active=resp_ok & ok)
+                reject = resp_ok & ~ok
                 if bool(np.asarray(reject).any()):
                     onehot = jnp.arange(m) == peer
                     dec = jnp.maximum(nxt - 1, 1)

@@ -15,11 +15,12 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 import urllib.error
 import urllib.request
 from typing import Callable
 
-from ..wire import Message
+from ..wire import MSG_APP, Message
 from .cluster import RAFT_PREFIX, ClusterStore
 
 log = logging.getLogger(__name__)
@@ -37,28 +38,40 @@ def default_post(url: str, data: bytes, timeout: float = 1.0) -> bool:
 
 
 def new_sender(cluster_store: ClusterStore,
-               post_fn: Callable[[str, bytes], bool] | None = None):
-    """Returns send(msgs) that MUST NOT block (server.go:202-206)."""
+               post_fn: Callable[[str, bytes], bool] | None = None,
+               leader_stats=None):
+    """Returns send(msgs) that MUST NOT block (server.go:202-206).
+
+    ``leader_stats`` (server/stats.py LeaderStats) records per-follower
+    append round-trip latency and failures when provided.
+    """
     post = post_fn or default_post
 
     def send(msgs: list[Message]) -> None:
         for m in msgs:
             t = threading.Thread(target=_send_one,
-                                 args=(cluster_store, m, post),
+                                 args=(cluster_store, m, post,
+                                       leader_stats),
                                  daemon=True)
             t.start()
 
     return send
 
 
-def _send_one(cls: ClusterStore, m: Message, post) -> None:
+def _send_one(cls: ClusterStore, m: Message, post, stats=None) -> None:
     """Three attempts, address re-picked per try
     (cluster_store.go:118-144)."""
     data = m.marshal()
+    track = stats is not None and m.type == MSG_APP
     for _ in range(3):
         u = cls.get().pick(m.to)
         if not u:
             log.warning("etcdhttp: no addr for %x", m.to)
             return
+        t0 = time.perf_counter()
         if post(u + RAFT_PREFIX, data):
+            if track:
+                stats.observe(m.to, time.perf_counter() - t0)
             return
+    if track:
+        stats.fail(m.to)

@@ -33,12 +33,14 @@ from ..wire import (
     ENTRY_CONF_CHANGE,
     ENTRY_NORMAL,
     HardState,
+    MSG_APP,
     Message,
     Snapshot,
     is_empty_snap,
 )
 from ..wire.requests import Info, Request
 from .cluster import ATTRIBUTES_SUFFIX, Cluster, ClusterStore, Member
+from .stats import LeaderStats, ServerStats
 from .config import ServerConfig
 from .sender import new_sender
 
@@ -106,7 +108,8 @@ class EtcdServer:
                  cluster_store: ClusterStore,
                  snap_count: int = DEFAULT_SNAP_COUNT,
                  tick_interval: float = TICK_INTERVAL,
-                 sync_interval: float = SYNC_INTERVAL):
+                 sync_interval: float = SYNC_INTERVAL,
+                 leader_stats: LeaderStats | None = None):
         self.store = store
         self.node = node
         self.id = id
@@ -124,6 +127,9 @@ class EtcdServer:
         self._publish_thread: threading.Thread | None = None
         self.raft_index = 0
         self.raft_term = 0
+        self.server_stats = ServerStats(
+            attributes.get("Name", ""), id)
+        self.leader_stats = leader_stats or LeaderStats(id)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -152,6 +158,8 @@ class EtcdServer:
 
     def process(self, m: Message) -> None:
         """Peer /raft endpoint feeds here (server.go:243-245)."""
+        if m.type == MSG_APP:
+            self.server_stats.recv_append()
         self.node.step(m)
 
     # -- the apply loop ----------------------------------------------------
@@ -184,6 +192,9 @@ class EtcdServer:
             # persist BEFORE send (the Ready contract, node.go:41-60)
             self.storage.save(rd.hard_state, rd.entries)
             self.storage.save_snap(rd.snapshot)
+            for m in rd.messages:
+                if m.type == MSG_APP:
+                    self.server_stats.send_append()
             self.send(rd.messages)
 
             for e in rd.committed_entries:
@@ -203,6 +214,8 @@ class EtcdServer:
             if rd.soft_state is not None:
                 nodes = rd.soft_state.nodes
                 is_leader = rd.soft_state.raft_state == STATE_LEADER
+                self.server_stats.set_state(
+                    rd.soft_state.raft_state, rd.soft_state.lead)
                 if rd.soft_state.should_stop:
                     self.stop()
                     return
@@ -492,6 +505,7 @@ def new_server(cfg: ServerConfig, *, discoverer=None,
                          hard_state, ents)
 
     cls = ClusterStore(st)
+    lstats = LeaderStats(m.id)
     return EtcdServer(
         store=st,
         node=n,
@@ -499,7 +513,8 @@ def new_server(cfg: ServerConfig, *, discoverer=None,
         attributes={"Name": cfg.name,
                     "ClientURLs": cfg.client_urls},
         storage=WalSnapStorage(w, ss),
-        send=new_sender(cls, post_fn=post_fn),
+        send=new_sender(cls, post_fn=post_fn, leader_stats=lstats),
+        leader_stats=lstats,
         cluster_store=cls,
         snap_count=cfg.snap_count,
     )

@@ -419,3 +419,23 @@ def test_readonly_proxy_rejects_writes(live_server):
         assert status == 200
     finally:
         httpd.shutdown()
+
+
+def test_stats_endpoints(live_server):
+    """/v2/stats/{self,store,leader} (observability, SURVEY §5.5)."""
+    base = live_server["base"]
+    http("PUT", f"{base}/v2/keys/statk", {"value": "v"})
+    code, _, body = http("GET", f"{base}/v2/stats/self")
+    assert code == 200
+    d = json.loads(body)
+    assert d["state"] in ("StateLeader", "StateFollower",
+                          "StateCandidate")
+    assert "leaderInfo" in d and "startTime" in d
+    code, _, body = http("GET", f"{base}/v2/stats/store")
+    assert code == 200
+    assert json.loads(body).get("setsSuccess", 0) >= 1
+    code, _, body = http("GET", f"{base}/v2/stats/leader")
+    assert code == 200
+    assert "leader" in json.loads(body)
+    code, _, _ = http("GET", f"{base}/v2/stats/bogus")
+    assert code == 404

@@ -162,3 +162,69 @@ def test_deposed_leader_propose_stores_nothing():
                data=[[b"stale"] for _ in range(4)])
     for gi in range(4):
         assert mr.payloads[gi] == before[gi]
+
+
+def test_drop_mask_delays_but_converges():
+    """Per-edge message drops (the lossy-network matrix): a dropped
+    follower lags, quorum still commits, healing catches it up."""
+    mr = MultiRaft(g=8, m=3, cap=64)
+    mr.campaign(0)
+    drop = {(0, 2): np.ones(8, bool)}  # isolate member 2 inbound
+    mr.propose(np.full(8, 3, np.int32), drop=drop)
+    for _ in range(3):
+        mr.replicate(drop=drop)
+    np.testing.assert_array_equal(mr.commit_index(), 4)  # 2-of-3 quorum
+    lag = np.asarray(mr.states[2].last)
+    assert (lag < 4).all()
+    for _ in range(3):  # heal
+        mr.replicate()
+    assert (np.asarray(mr.states[2].last) == 4).all()
+    assert (np.asarray(mr.states[2].commit) == 4).all()
+
+
+def test_drop_both_followers_blocks_commit():
+    mr = MultiRaft(g=4, m=3, cap=64)
+    mr.campaign(0)
+    base = mr.commit_index().copy()
+    drop = {(0, 1): np.ones(4, bool), (0, 2): np.ones(4, bool)}
+    mr.propose(np.full(4, 2, np.int32), drop=drop)
+    for _ in range(3):
+        mr.replicate(drop=drop)
+    np.testing.assert_array_equal(mr.commit_index(), base)
+    mr.replicate()  # heal: commit catches up
+    np.testing.assert_array_equal(mr.commit_index(), base + 2)
+
+
+def test_lost_ack_resends_idempotently():
+    """Follower receives appends but its acks are dropped: leader
+    retries the same window; duplicate appends are idempotent."""
+    mr = MultiRaft(g=4, m=3, cap=64)
+    mr.campaign(0)
+    drop = {(1, 0): np.ones(4, bool)}  # member 1's responses lost
+    mr.propose(np.full(4, 2, np.int32), drop=drop)
+    for _ in range(2):
+        mr.replicate(drop=drop)
+    # member 1 HAS the entries but leader's match for it is stale;
+    # member 2 alone still forms a 2/3 quorum with the leader
+    np.testing.assert_array_equal(mr.commit_index(), 3)
+    assert (np.asarray(mr.states[1].last) == 3).all()
+    mr.replicate()  # acks flow again; no duplication, logs intact
+    np.testing.assert_array_equal(mr.commit_index(), 3)
+    for g in range(4):
+        assert _logs_equal(mr, g, 3)
+
+
+def test_truncated_payload_invalidated():
+    """A deposed leader's uncommitted payload must not survive the
+    election that truncates its entry (review repro)."""
+    mr = MultiRaft(g=4, m=3, cap=64)
+    mr.campaign(0)
+    drop = {(0, 1): np.ones(4, bool), (0, 2): np.ones(4, bool)}
+    mr.propose(np.full(4, 1, np.int32),
+               data=[[b"STALE"] for _ in range(4)], drop=drop)
+    assert mr.committed_payload(0, 2) == b"STALE"  # stored, uncommitted
+    mr.campaign(1)  # winner's log lacks index 2; empty entry lands there
+    for _ in range(3):
+        mr.replicate()
+    assert (mr.commit_index() >= 2).all()
+    assert mr.committed_payload(0, 2) is None
