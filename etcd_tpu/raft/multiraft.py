@@ -126,10 +126,11 @@ class MultiRaft:
             # the new term may overwrite — drop them.
             winner_last = np.asarray(cand.last)
             for gi in np.nonzero(won_np)[0]:
+                p = self.payloads[gi]
                 cut = int(winner_last[gi])
-                self.payloads[gi] = {
-                    k: v for k, v in self.payloads[gi].items()
-                    if k <= cut}
+                if p and max(p) > cut:  # skip the common no-op case
+                    self.payloads[gi] = {
+                        k: v for k, v in p.items() if k <= cut}
             # the becoming-leader empty entry (raft.go:329-348)
             self.propose(np.where(won_np, 1, 0).astype(np.int32))
         return won_np

@@ -67,6 +67,8 @@ def _send_one(cls: ClusterStore, m: Message, post, stats=None) -> None:
         u = cls.get().pick(m.to)
         if not u:
             log.warning("etcdhttp: no addr for %x", m.to)
+            if track:  # unreachable == failed, for /v2/stats/leader
+                stats.fail(m.to)
             return
         t0 = time.perf_counter()
         if post(u + RAFT_PREFIX, data):

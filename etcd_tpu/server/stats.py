@@ -79,13 +79,16 @@ class LeaderStats:
         self._lock = threading.Lock()
         self.followers: dict[str, dict] = {}
 
+    def _entry(self, follower_id: int) -> dict:
+        return self.followers.setdefault(
+            f"{follower_id:x}",
+            {"latency": {"current": 0.0, "average": 0.0,
+                         "minimum": float("inf"), "maximum": 0.0},
+             "counts": {"success": 0, "fail": 0}})
+
     def observe(self, follower_id: int, latency_s: float) -> None:
         with self._lock:
-            f = self.followers.setdefault(
-                f"{follower_id:x}",
-                {"latency": {"current": 0.0, "average": 0.0,
-                             "minimum": float("inf"), "maximum": 0.0},
-                 "counts": {"success": 0, "fail": 0}})
+            f = self._entry(follower_id)
             lat = f["latency"]
             cnt = f["counts"]
             cnt["success"] += 1
@@ -97,12 +100,7 @@ class LeaderStats:
 
     def fail(self, follower_id: int) -> None:
         with self._lock:
-            f = self.followers.setdefault(
-                f"{follower_id:x}",
-                {"latency": {"current": 0.0, "average": 0.0,
-                             "minimum": float("inf"), "maximum": 0.0},
-                 "counts": {"success": 0, "fail": 0}})
-            f["counts"]["fail"] += 1
+            self._entry(follower_id)["counts"]["fail"] += 1
 
     def to_json(self) -> bytes:
         with self._lock:
