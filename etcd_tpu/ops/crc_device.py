@@ -213,8 +213,24 @@ def chain_verify_device(seed: int, stored, raw, lens) -> jnp.ndarray:
     stored = jnp.asarray(stored, dtype=jnp.uint32)
     if stored.size == 0:
         return jnp.zeros((0,), dtype=bool)
-    raw = jnp.asarray(raw, dtype=jnp.uint32)
-    lens = jnp.asarray(lens, dtype=jnp.uint32)
     prev = jnp.concatenate(
         [jnp.asarray([seed], dtype=jnp.uint32), stored[:-1]])
-    return _chain_expected(prev, raw, lens) == stored
+    return chain_links_device(prev, stored, raw, lens)
+
+
+def chain_links_device(prev, stored, raw, lens) -> jnp.ndarray:
+    """Link-wise chain verification with an explicit prev vector:
+    bool [N] where ``update(prev[i], data_i) == stored[i]``.
+
+    The general (multi-stream) form: rows from many independent
+    chains — e.g. every co-hosted group's WAL in one batch — verify
+    together because each link only needs its own predecessor's
+    stored value.
+    """
+    prev = jnp.asarray(prev, dtype=jnp.uint32)
+    if prev.size == 0:
+        return jnp.zeros((0,), dtype=bool)
+    raw = jnp.asarray(raw, dtype=jnp.uint32)
+    lens = jnp.asarray(lens, dtype=jnp.uint32)
+    return _chain_expected(prev, raw, lens) == \
+        jnp.asarray(stored, dtype=jnp.uint32)

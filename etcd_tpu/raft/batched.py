@@ -249,6 +249,37 @@ def compact(state: GroupState, idx, active=None):
 
 
 @jax.jit
+def restore_snapshot(state: GroupState, idx, term, commit=None,
+                     active=None):
+    """Install a snapshot into the masked groups (raft.go:535-554 +
+    log.go:185-191 batched): the log collapses to a single dummy slot
+    at ``idx`` carrying ``term`` (for future match checks), and
+    commit/applied jump to ``idx``.  The state-machine payload itself
+    is the host's concern (SURVEY §7: opaque blobs stay host-side).
+
+    Guard (raft.go:536-538): lanes whose commit already reaches
+    ``idx`` REJECT the snapshot — commit/applied never regress and
+    already-committed suffixes are not truncated.  Returns
+    ``(state', installed)``; rejected-but-active lanes are the
+    follower's "reply with my commit" case (raft.go:419-424).
+    """
+    g, cap = state.log_term.shape
+    if active is None:
+        active = jnp.ones((g,), bool)
+    if commit is None:
+        commit = idx
+    installed = active & (idx > state.commit)
+    slot0 = jnp.concatenate(
+        [term[:, None], jnp.zeros((g, cap - 1), jnp.int32)], axis=1)
+    return state._replace(
+        log_term=jnp.where(installed[:, None], slot0, state.log_term),
+        offset=jnp.where(installed, idx, state.offset),
+        last=jnp.where(installed, idx, state.last),
+        commit=jnp.where(installed, commit, state.commit),
+        applied=jnp.where(installed, commit, state.applied)), installed
+
+
+@jax.jit
 def tick(state: GroupState, heartbeat: int = 1):
     """Batched tick (raft.go:288-301): advance timers, report which
     groups fire an election timeout (followers/candidates) or a

@@ -33,9 +33,11 @@ from .batched import (
     grant_vote,
     init_groups,
     leader_append,
+    compact as compact_batch,
     maybe_append,
     maybe_commit,
     progress_update,
+    restore_snapshot,
     term_at,
     tick as tick_batch,
 )
@@ -221,6 +223,34 @@ class MultiRaft:
                     vote=jnp.where(adopt, -1, pst.vote),
                     role=jnp.where(send, FOLLOWER, pst.role),
                     lead=jnp.where(send, slot, pst.lead))
+                # slow follower fell behind the leader's compaction
+                # point: send a snapshot instead (raft.go:207-209,
+                # needSnapshot :556); the follower's log collapses to
+                # the leader's offset entry and normal appends resume
+                needs_snap = send & (nxt <= lst.offset) & \
+                    (lst.offset > 0)
+                if bool(np.asarray(needs_snap).any()):
+                    snap_term = term_at(lst.log_term, lst.offset,
+                                        lst.last, lst.offset)
+                    follower_commit = pst.commit
+                    pst, installed = restore_snapshot(
+                        pst, lst.offset, snap_term,
+                        commit=jnp.minimum(lst.commit, lst.offset),
+                        active=needs_snap)
+                    # installed lanes ack the snapshot index; lanes
+                    # that rejected (commit already past it) reply
+                    # with their commit, repairing the leader's stale
+                    # next_ without any truncation (raft.go:419-424)
+                    peer_v = jnp.full((g,), peer, jnp.int32)
+                    lst = progress_update(
+                        lst, peer_v, lst.offset, active=installed)
+                    rejected = needs_snap & ~installed
+                    lst = progress_update(
+                        lst, peer_v, follower_commit, active=rejected)
+                    nxt = jnp.where(
+                        installed, lst.offset + 1,
+                        jnp.where(rejected, follower_commit + 1, nxt))
+
                 prev_idx = nxt - 1
                 prev_term = term_at(lst.log_term, lst.offset, lst.last,
                                     prev_idx)
@@ -260,6 +290,43 @@ class MultiRaft:
             lst = maybe_commit(lst)
             self.states[slot] = lst
         return self._commit_vector() - commits_before
+
+    def mark_applied(self, upto: np.ndarray) -> None:
+        """The host consumer declares it has applied entries up to
+        ``upto[g]`` (clamped to each member's commit).  Compaction
+        never slides past this point, so committed-but-unconsumed
+        payloads stay retrievable."""
+        upto = jnp.asarray(upto, jnp.int32)
+        for slot in range(self.m):
+            st = self.states[slot]
+            st = st._replace(applied=jnp.maximum(
+                st.applied, jnp.minimum(upto, st.commit)))
+            self.states[slot] = st
+
+    def compact(self, upto: np.ndarray | None = None) -> None:
+        """Compact every member's log at its applied index (the
+        reference couples this to the snapshot trigger,
+        server.go:313-316 + log.go:161); payloads below the
+        compaction point are dropped from the host ring.  Call
+        :meth:`mark_applied` first — compaction never outruns what
+        the consumer declared applied."""
+        for slot in range(self.m):
+            st = self.states[slot]
+            idx = st.applied
+            if upto is not None:
+                idx = jnp.minimum(idx, jnp.asarray(upto, jnp.int32))
+            st, err = compact_batch(st, jnp.maximum(idx, st.offset))
+            if bool(np.asarray(err).any()):
+                raise RuntimeError("compact out of bounds")
+            self.states[slot] = st
+        cut = np.min(np.stack(
+            [np.asarray(st.offset) for st in self.states]), axis=0)
+        for gi in range(self.g):
+            p = self.payloads[gi]
+            c = int(cut[gi])
+            if p and min(p) < c:
+                self.payloads[gi] = {k: v for k, v in p.items()
+                                     if k >= c}
 
     def tick(self) -> None:
         """Advance every member's timers; campaign where they fire."""

@@ -228,3 +228,42 @@ def test_truncated_payload_invalidated():
         mr.replicate()
     assert (mr.commit_index() >= 2).all()
     assert mr.committed_payload(0, 2) is None
+
+
+def test_compact_and_snapshot_catchup():
+    """Leader compaction strands a lagging follower behind the log
+    window; the msgSnap path restores it and replication resumes
+    (raft.go:207-209, needSnapshot)."""
+    mr = MultiRaft(g=4, m=3, cap=64)
+    mr.campaign(0)
+    drop = {(0, 2): np.ones(4, bool)}  # member 2 isolated
+    mr.propose(np.full(4, 6, np.int32), drop=drop)
+    for _ in range(3):
+        mr.replicate(drop=drop)
+    np.testing.assert_array_equal(mr.commit_index(), 7)
+    assert (np.asarray(mr.states[2].last) < 7).all()
+    mr.mark_applied(mr.commit_index())
+    mr.compact()  # leader log now starts at commit=7
+    assert (np.asarray(mr.states[0].offset) == 7).all()
+    for _ in range(3):  # heal: snapshot then normal appends
+        mr.replicate()
+    assert (np.asarray(mr.states[2].offset) == 7).all()
+    assert (np.asarray(mr.states[2].commit) == 7).all()
+    # replication continues past the snapshot
+    mr.propose(np.full(4, 2, np.int32))
+    for _ in range(2):
+        mr.replicate()
+    np.testing.assert_array_equal(mr.commit_index(), 9)
+    assert (np.asarray(mr.states[2].last) == 9).all()
+
+
+def test_compact_prunes_payloads():
+    mr = MultiRaft(g=2, m=3, cap=64)
+    mr.campaign(0)
+    mr.propose(np.full(2, 3, np.int32),
+               data=[[b"a", b"b", b"c"], [b"x", b"y", b"z"]])
+    assert mr.committed_payload(0, 2) == b"a"
+    mr.replicate()  # propagate the commit frontier to followers
+    mr.mark_applied(mr.commit_index())
+    mr.compact()
+    assert mr.committed_payload(0, 2) is None  # pruned below offset
