@@ -56,22 +56,37 @@ class GroupState(NamedTuple):
     nmembers: jnp.ndarray   # [G] i32 live member count
     elapsed: jnp.ndarray    # [G] i32 ticks since last reset
     timeout: jnp.ndarray    # [G] i32 randomized election timeout
+    members: jnp.ndarray    # [G, M] bool live-membership mask (a
+                            # non-member slot is either removed or not
+                            # yet added — both are masked edges; the
+                            # reference's msgDenied self-stop,
+                            # raft.go:376-387, has no message to deny
+                            # in the shared-state co-hosted runtime)
 
     @property
     def cap(self) -> int:
         return self.log_term.shape[1]
 
 
-def init_groups(g: int, m: int, cap: int, election: int = 10) -> GroupState:
-    """Fresh follower groups at term 0 with empty logs."""
+def init_groups(g: int, m: int, cap: int, election: int = 10,
+                live: int | None = None) -> GroupState:
+    """Fresh follower groups at term 0 with empty logs.
+
+    ``live``: how many of the ``m`` member slots start as cluster
+    members (default all) — the rest are addable later via
+    :func:`apply_conf_change` (grow-the-cluster bootstrap).
+    """
     zi = jnp.zeros((g,), jnp.int32)
+    live = m if live is None else live
+    members = jnp.tile(jnp.arange(m) < live, (g, 1))
     return GroupState(
         term=zi, vote=zi - 1, role=zi + FOLLOWER, lead=zi - 1,
         commit=zi, applied=zi,
         log_term=jnp.zeros((g, cap), jnp.int32), offset=zi, last=zi,
         match=jnp.zeros((g, m), jnp.int32),
         next_=jnp.ones((g, m), jnp.int32),
-        nmembers=zi + m, elapsed=zi, timeout=zi + election,
+        nmembers=zi + live, elapsed=zi, timeout=zi + election,
+        members=members,
     )
 
 
@@ -125,9 +140,13 @@ def maybe_append(state: GroupState, prev_idx, prev_term, ent_terms,
     [G] bool mask of groups actually receiving an append (inactive
     groups pass through unchanged).
 
-    Returns ``(state', ok, err)``: ``ok`` = the append was accepted
-    (msgAppResp success), ``err`` = a reference-panic condition
-    (conflict below commit, log.go:57; or capacity overflow).
+    Returns ``(state', ok, err_conflict, err_overflow)``:
+    ``ok`` = the append was accepted (msgAppResp success);
+    ``err_conflict`` = conflict below commit, a reference-panic
+    condition (log.go:57); ``err_overflow`` = log-capacity overflow
+    (compact and retry).  Error lanes leave the group's state
+    untouched and respond with a reject — one hot or corrupted group
+    never poisons the batch.
     """
     g, cap = state.log_term.shape
     e = ent_terms.shape[1]
@@ -148,8 +167,9 @@ def maybe_append(state: GroupState, prev_idx, prev_term, ent_terms,
     ci = prev_idx + 1 + ci_rel
     lastnewi = prev_idx + n_ents
 
-    err = ok & conflict & (ci <= state.commit)
-    err |= ok & (lastnewi - state.offset >= cap)
+    err_conflict = ok & conflict & (ci <= state.commit)
+    err_overflow = ok & (lastnewi - state.offset >= cap)
+    ok = ok & ~(err_conflict | err_overflow)
 
     # truncating append as one masked window write: slots in
     # [prev_idx+1, lastnewi] take the incoming terms (identical values
@@ -166,7 +186,7 @@ def maybe_append(state: GroupState, prev_idx, prev_term, ent_terms,
     commit = jnp.where(ok & (tocommit > state.commit), tocommit,
                        state.commit)
     return state._replace(log_term=log_term, last=last,
-                          commit=commit), ok, err
+                          commit=commit), ok, err_conflict, err_overflow
 
 
 @jax.jit
@@ -175,27 +195,33 @@ def leader_append(state: GroupState, n_new, self_slot, active=None):
     entries of the leader's term, update own progress.
 
     Returns ``(state', err)`` with err = capacity overflow lanes.
+    Overflow lanes are left untouched (no partial window write, no
+    ``last`` advance): the group stalls until compaction frees space
+    while the rest of the batch proceeds.
     """
     g, cap = state.log_term.shape
     if active is None:
         active = jnp.ones((g,), bool)
-    active = active & (state.role == LEADER)
+    self_live = jnp.take_along_axis(
+        state.members, self_slot[:, None], axis=1)[:, 0]
+    active = active & (state.role == LEADER) & self_live
 
     lastnew = state.last + n_new
     err = active & (lastnew - state.offset >= cap)
+    do = active & ~err
 
     cap_idx = state.offset[:, None] + jnp.arange(cap, dtype=jnp.int32)
-    write = active[:, None] & (cap_idx > state.last[:, None]) & \
+    write = do[:, None] & (cap_idx > state.last[:, None]) & \
         (cap_idx <= lastnew[:, None])
     log_term = jnp.where(write, state.term[:, None], state.log_term)
 
     m = state.match.shape[1]
     onehot = jax.nn.one_hot(self_slot, m, dtype=bool)
-    match = jnp.where(active[:, None] & onehot, lastnew[:, None],
+    match = jnp.where(do[:, None] & onehot, lastnew[:, None],
                       state.match)
-    next_ = jnp.where(active[:, None] & onehot, lastnew[:, None] + 1,
+    next_ = jnp.where(do[:, None] & onehot, lastnew[:, None] + 1,
                       state.next_)
-    last = jnp.where(active, lastnew, state.last)
+    last = jnp.where(do, lastnew, state.last)
     return state._replace(log_term=log_term, last=last, match=match,
                           next_=next_), err
 
@@ -219,8 +245,10 @@ def progress_update(state: GroupState, from_slot, idx, active=None):
 @jax.jit
 def maybe_commit(state: GroupState) -> GroupState:
     """Quorum commit advance (raft.go:248-258 + log.go:88-95) for all
-    leader groups: q-th largest match, gated on current-term entry."""
-    mci = commit_index_batch(state.match, state.nmembers)
+    leader groups: q-th largest LIVE match, gated on current-term
+    entry (a removed member's stale match must not form quorums)."""
+    mci = commit_index_batch(
+        jnp.where(state.members, state.match, 0), state.nmembers)
     t_at = term_at(state.log_term, state.offset, state.last, mci)
     ok = (state.role == LEADER) & (mci > state.commit) & \
         (t_at == state.term)
@@ -250,12 +278,16 @@ def compact(state: GroupState, idx, active=None):
 
 @jax.jit
 def restore_snapshot(state: GroupState, idx, term, commit=None,
-                     active=None):
+                     active=None, members=None):
     """Install a snapshot into the masked groups (raft.go:535-554 +
     log.go:185-191 batched): the log collapses to a single dummy slot
     at ``idx`` carrying ``term`` (for future match checks), and
     commit/applied jump to ``idx``.  The state-machine payload itself
     is the host's concern (SURVEY §7: opaque blobs stay host-side).
+
+    ``members``: optional [G, M] snapshot-carried membership
+    (raft.go:535-554 rebuilds prs from s.Nodes) — installed lanes
+    adopt it, with nmembers recounted.
 
     Guard (raft.go:536-538): lanes whose commit already reaches
     ``idx`` REJECT the snapshot — commit/applied never regress and
@@ -271,12 +303,51 @@ def restore_snapshot(state: GroupState, idx, term, commit=None,
     installed = active & (idx > state.commit)
     slot0 = jnp.concatenate(
         [term[:, None], jnp.zeros((g, cap - 1), jnp.int32)], axis=1)
+    new_members = state.members
+    nmembers = state.nmembers
+    if members is not None:
+        new_members = jnp.where(installed[:, None], members,
+                                state.members)
+        nmembers = new_members.sum(axis=1).astype(jnp.int32)
     return state._replace(
         log_term=jnp.where(installed[:, None], slot0, state.log_term),
         offset=jnp.where(installed, idx, state.offset),
         last=jnp.where(installed, idx, state.last),
         commit=jnp.where(installed, commit, state.commit),
-        applied=jnp.where(installed, commit, state.applied)), installed
+        applied=jnp.where(installed, commit, state.applied),
+        members=new_members, nmembers=nmembers), installed
+
+
+@jax.jit
+def apply_conf_change(state: GroupState, add, slot, self_slot,
+                      active=None):
+    """Batched ConfChange apply (raft.go:376-387,431-435 semantics).
+
+    ``add`` [G] bool (True = AddNode, False = RemoveNode), ``slot``
+    [G] i32 the member slot being changed, ``self_slot`` [G] i32 the
+    slot THIS state belongs to (a member removing itself steps down
+    to follower — the reference's ShouldStop self-stop,
+    raft.go:158-161).  A newly added member starts with match 0 and
+    next = last+1 (raft.go:349-351 set_progress); nmembers recounts,
+    so quorums and vote counts track the live size.
+    """
+    g, m = state.match.shape
+    if active is None:
+        active = jnp.ones((g,), bool)
+    onehot = jax.nn.one_hot(slot, m, dtype=bool) & active[:, None]
+    members = jnp.where(onehot, add[:, None], state.members)
+    newly = onehot & add[:, None] & ~state.members
+    match = jnp.where(newly, 0, state.match)
+    next_ = jnp.where(newly, state.last[:, None] + 1, state.next_)
+    nmembers = members.sum(axis=1).astype(jnp.int32)
+    self_removed = active & ~add & (slot == self_slot)
+    role = jnp.where(self_removed, FOLLOWER, state.role)
+    # a group whose leader was removed has no leader until the next
+    # election
+    lead = jnp.where(active & ~add & (slot == state.lead), -1,
+                     state.lead)
+    return state._replace(members=members, match=match, next_=next_,
+                          nmembers=nmembers, role=role, lead=lead)
 
 
 @jax.jit

@@ -10,9 +10,17 @@ serialization, no sockets (SURVEY §5.8: intra-slice communication is
 sharded-array collectives; inter-member DCN transport stays at the
 server layer for cross-host peers).
 
-The hot path (propose → replicate → respond → commit) runs entirely
-as batched device ops (raft/batched.py); elections run batched too
-(grant_vote quorum across members), fired by the batched tick timers.
+The hot path (propose → replicate → respond → commit) is ONE fused
+jit call per round (`_fused_round`): all M² member-pair exchanges and
+the quorum commit run on device; the host syncs once for the returned
+commit delta.  Elections are batched and fused too, decomposed into
+droppable vote-request / vote-response phases sharing the same
+per-edge fault mask machinery as replication (the batched analog of
+the reference's lossy fake network, raft_test.go:1258-1287).
+
+Error lanes are per-group: an overflowing or conflicted group stalls
+alone (its lanes surface in :attr:`MultiRaft.errors`) while the rest
+of the batch keeps committing — no batch-wide exceptions.
 
 Payload bytes stay host-side (a per-group ring keyed by log index —
 the wrong shape for HBM), mirroring the split in SURVEY §7: the
@@ -21,8 +29,11 @@ device owns index/term/commit math, the host owns opaque blobs.
 
 from __future__ import annotations
 
+from functools import partial
+
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from .batched import (
@@ -30,6 +41,7 @@ from .batched import (
     FOLLOWER,
     LEADER,
     GroupState,
+    apply_conf_change as conf_change_batch,
     grant_vote,
     init_groups,
     leader_append,
@@ -43,17 +55,236 @@ from .batched import (
 )
 
 
+def _drop_dense(drop, m: int, g: int) -> np.ndarray:
+    """Per-edge fault dict {(a, b): [G] bool} → dense [M, M, G]."""
+    dense = np.zeros((m, m, g), bool)
+    for (a, b), mask in (drop or {}).items():
+        dense[a, b] |= np.asarray(mask, bool)
+    return dense
+
+
+@partial(jax.jit, static_argnames=("e",))
+def _fused_round(states, leader, n_new, drop, e):
+    """One full propose→replicate→respond→commit round, on device.
+
+    ``states``: tuple of M GroupState pytrees; ``leader``: [G] i32
+    member slot per group (-1 none); ``n_new``: [G] i32 proposals to
+    append at each group's leader; ``drop``: [M, M, G] bool per-edge
+    fault mask (drop[a, b, g] kills a→b messages of group g).
+
+    Returns ``(states', newly_committed, valid, base, overflow,
+    conflict)`` — valid/base key the host payload store (which groups
+    had a real leader, and its pre-append last index); overflow /
+    conflict are the per-group error lanes.
+    """
+    states = list(states)
+    m = len(states)
+    g = leader.shape[0]
+
+    commits0 = states[0].commit
+    for st in states[1:]:
+        commits0 = jnp.maximum(commits0, st.commit)
+
+    valid = jnp.zeros((g,), bool)
+    base = jnp.zeros((g,), jnp.int32)
+    overflow = jnp.zeros((g,), bool)
+    conflict = jnp.zeros((g,), bool)
+
+    # -- leader appends (raft.go:279-286), masked per slot -------------
+    for slot in range(m):
+        sel = leader == slot
+        st = states[slot]
+        is_lead = sel & (st.role == LEADER)
+        valid = valid | is_lead
+        base = jnp.where(is_lead, st.last, base)
+        st, err = leader_append(
+            st, jnp.where(sel, n_new, 0),
+            jnp.full((g,), slot, jnp.int32), active=sel)
+        overflow |= err
+        states[slot] = st
+    # groups whose append was refused (overflow) must not key host
+    # payloads: their log never advanced past base
+    valid = valid & ~overflow
+
+    # -- replication: leaders send, followers respond, quorum commits --
+    for slot in range(m):
+        sel = leader == slot
+        lst = states[slot]
+        for peer in range(m):
+            if peer == slot:
+                continue
+            pst = states[peer]
+            # window: follower's next.. min(next+E-1, leader last)
+            nxt = jnp.take_along_axis(
+                lst.next_, jnp.full((g, 1), peer, jnp.int32),
+                axis=1)[:, 0]
+            # followers at a lower term adopt the leader's
+            # (raft.go:388-396); stale leaders don't send; removed /
+            # not-yet-added slots are masked edges on both ends
+            send = sel & (lst.term >= pst.term) & \
+                (lst.role == LEADER) & ~drop[slot, peer] & \
+                lst.members[:, slot] & lst.members[:, peer]
+            adopt = send & (lst.term > pst.term)
+            pst = pst._replace(
+                term=jnp.where(adopt, lst.term, pst.term),
+                vote=jnp.where(adopt, -1, pst.vote),
+                role=jnp.where(send, FOLLOWER, pst.role),
+                lead=jnp.where(send, slot, pst.lead))
+            # slow follower fell behind the leader's compaction
+            # point: send a snapshot instead (raft.go:207-209,
+            # needSnapshot :556); the follower's log collapses to
+            # the leader's offset entry and normal appends resume
+            needs_snap = send & (nxt <= lst.offset) & (lst.offset > 0)
+            snap_term = term_at(lst.log_term, lst.offset, lst.last,
+                                lst.offset)
+            follower_commit = pst.commit
+            pst, installed = restore_snapshot(
+                pst, lst.offset, snap_term,
+                commit=jnp.minimum(lst.commit, lst.offset),
+                active=needs_snap, members=lst.members)
+            # installed lanes ack the snapshot index; lanes that
+            # rejected (commit already past it) reply with their
+            # commit, repairing the leader's stale next_ without any
+            # truncation (raft.go:419-424)
+            peer_v = jnp.full((g,), peer, jnp.int32)
+            lst = progress_update(lst, peer_v, lst.offset,
+                                  active=installed)
+            rejected = needs_snap & ~installed
+            lst = progress_update(lst, peer_v, follower_commit,
+                                  active=rejected)
+            nxt = jnp.where(
+                installed, lst.offset + 1,
+                jnp.where(rejected, follower_commit + 1, nxt))
+
+            prev_idx = nxt - 1
+            prev_term = term_at(lst.log_term, lst.offset, lst.last,
+                                prev_idx)
+            n_send = jnp.clip(lst.last - prev_idx, 0, e)
+            ent_idx = prev_idx[:, None] + 1 + \
+                jnp.arange(e, dtype=jnp.int32)
+            ent_terms = term_at(lst.log_term, lst.offset, lst.last,
+                                ent_idx)
+            pst, ok, e_conf, e_over = maybe_append(
+                pst, prev_idx, prev_term, ent_terms, n_send,
+                lst.commit, active=send)
+            conflict |= e_conf
+            overflow |= e_over
+            # any append from the legitimate leader resets the
+            # follower's election timer (otherwise every follower
+            # would depose a healthy leader each `timeout` ticks)
+            pst = pst._replace(elapsed=jnp.where(send, 0, pst.elapsed))
+            states[peer] = pst
+            # msgAppResp: success → progress update; reject →
+            # decrement next (raft.go:464-470 batched); the response
+            # direction drops independently
+            resp_ok = send & ~drop[peer, slot]
+            acked = prev_idx + n_send
+            lst = progress_update(lst, peer_v, acked,
+                                  active=resp_ok & ok)
+            reject = resp_ok & ~ok
+            onehot = jnp.arange(m) == peer
+            dec = jnp.maximum(nxt - 1, 1)
+            lst = lst._replace(next_=jnp.where(
+                reject[:, None] & onehot[None, :],
+                dec[:, None], lst.next_))
+        lst = maybe_commit(lst)
+        states[slot] = lst
+
+    commits1 = states[0].commit
+    for st in states[1:]:
+        commits1 = jnp.maximum(commits1, st.commit)
+    return (tuple(states), commits1 - commits0, valid, base,
+            overflow, conflict)
+
+
+@partial(jax.jit, static_argnames=("slot",))
+def _fused_campaign(states, mask, drop, slot):
+    """Batched campaign for member ``slot`` (raft.go:358-370), fused.
+
+    Vote requests and vote responses are separate droppable phases:
+    ``drop[slot, peer]`` kills the request (peer never votes),
+    ``drop[peer, slot]`` kills the response (peer's vote is RECORDED
+    but the candidate never learns of it — the asymmetry real lossy
+    networks produce, raft_test.go:204 dueling-candidates territory).
+
+    Returns ``(states', won)``; quorum uses each group's live member
+    count (nmembers), not the static member-slot count.
+    """
+    states = list(states)
+    m = len(states)
+    g = mask.shape[0]
+    mj = mask
+
+    cand = states[slot]
+    mj = mj & cand.members[:, slot]  # a non-member cannot campaign
+    new_term = cand.term + mj.astype(jnp.int32)
+    cand = cand._replace(
+        term=new_term,
+        role=jnp.where(mj, CANDIDATE, cand.role),
+        vote=jnp.where(mj, slot, cand.vote))
+
+    votes = mj.astype(jnp.int32)  # own vote
+    cand_last = cand.last
+    cand_lterm = term_at(cand.log_term, cand.offset, cand.last,
+                         cand.last)
+    for peer in range(m):
+        if peer == slot:
+            continue
+        st = states[peer]
+        req = mj & ~drop[slot, peer] & cand.members[:, peer]
+        # msgVote carries the candidate term; peers at a lower term
+        # adopt it and forget the deposed leader (becomeFollower with
+        # lead=None, raft.go:388-396 batched)
+        adopt = req & (cand.term > st.term)
+        st = st._replace(
+            term=jnp.where(adopt, cand.term, st.term),
+            vote=jnp.where(adopt, -1, st.vote),
+            role=jnp.where(adopt, FOLLOWER, st.role),
+            lead=jnp.where(adopt, -1, st.lead))
+        st, granted = grant_vote(
+            st, cand_last, cand_lterm, cand.term,
+            jnp.full((g,), slot, jnp.int32), active=req)
+        # granting a vote resets the election timer (the reference
+        # resets on any message from a legitimate candidate)
+        st = st._replace(elapsed=jnp.where(granted, 0, st.elapsed))
+        states[peer] = st
+        resp = granted & ~drop[peer, slot]
+        votes += resp.astype(jnp.int32)
+
+    quorum = cand.nmembers // 2 + 1
+    won = mj & (votes >= quorum)
+    # winners become leader; note the reference appends an empty
+    # entry on becoming leader (raft.go:329-348) so the new term has
+    # a committable entry — replicated via the normal path
+    cand = cand._replace(
+        role=jnp.where(won, LEADER, cand.role),
+        lead=jnp.where(won, slot, cand.lead),
+        match=jnp.where(won[:, None], 0, cand.match),
+        next_=jnp.where(won[:, None], cand.last[:, None] + 1,
+                        cand.next_))
+    states[slot] = cand
+    return tuple(states), won
+
+
 class MultiRaft:
-    """G co-hosted groups, M members each, batched across groups."""
+    """G co-hosted groups, M members each, batched across groups.
+
+    :attr:`errors` holds the per-group error lanes of the most recent
+    round: ``{"overflow": [G] bool, "conflict": [G] bool}``.
+    Overflowing groups stall (compact to resume) without blocking the
+    batch; conflict lanes mark the reference's panic condition
+    (append conflict below commit, log.go:57).
+    """
 
     def __init__(self, g: int, m: int, cap: int, election: int = 10,
-                 max_batch_ents: int = 8, seed: int = 0):
+                 max_batch_ents: int = 8, seed: int = 0,
+                 live: int | None = None):
         self.g, self.m, self.cap = g, m, cap
         self.e = max_batch_ents
         rng = np.random.default_rng(seed)
         self.states: list[GroupState] = []
         for slot in range(m):
-            st = init_groups(g, m, cap, election=election)
+            st = init_groups(g, m, cap, election=election, live=live)
             # randomized election timeouts (raft.go:611-617): each
             # member draws [election, 2*election) per group
             st = st._replace(timeout=jnp.asarray(
@@ -62,71 +293,36 @@ class MultiRaft:
         self.leader = np.full(g, -1, np.int32)  # member slot per group
         # host-side payload store: per-group dict index -> bytes
         self.payloads: list[dict[int, bytes]] = [dict() for _ in range(g)]
+        self.errors = {"overflow": np.zeros(g, bool),
+                       "conflict": np.zeros(g, bool),
+                       "compact_oob": np.zeros(g, bool)}
+        # fault-free rounds reuse one device-resident all-False mask
+        # instead of re-uploading an [M, M, G] array per call
+        self._no_drop = jnp.zeros((m, m, g), bool)
 
-    # -- elections (batched across groups) ------------------------------
+    # -- elections (batched, fused, droppable) ---------------------------
 
-    def campaign(self, slot: int, mask: np.ndarray | None = None
-                 ) -> np.ndarray:
-        """Member ``slot`` campaigns for the masked groups
-        (raft.go:358-370 batched): term+1, vote self, request votes
-        from every other member, count the quorum.
-
-        Returns the [G] bool mask of groups where it won.
+    def campaign(self, slot: int, mask: np.ndarray | None = None,
+                 drop=None) -> np.ndarray:
+        """Member ``slot`` campaigns for the masked groups: term+1,
+        vote self, request votes (droppable edges), count per-group
+        quorums.  Returns the [G] bool mask of groups where it won.
         """
-        g, m = self.g, self.m
-        mask = np.ones(g, bool) if mask is None else mask
-        mj = jnp.asarray(mask)
-        cand = self.states[slot]
-        new_term = cand.term + mj.astype(jnp.int32)
-        cand = cand._replace(
-            term=new_term,
-            role=jnp.where(mj, CANDIDATE, cand.role),
-            vote=jnp.where(mj, slot, cand.vote))
-
-        votes = np.ones(g, np.int64)  # own vote
-        cand_last = cand.last
-        cand_lterm = term_at(cand.log_term, cand.offset, cand.last,
-                             cand.last)
-        for peer in range(m):
-            if peer == slot:
-                continue
-            st = self.states[peer]
-            # msgVote carries the candidate term; peers at a lower
-            # term adopt it (raft.go:388-396 batched)
-            adopt = mj & (cand.term > st.term)
-            st = st._replace(
-                term=jnp.where(adopt, cand.term, st.term),
-                vote=jnp.where(adopt, -1, st.vote),
-                role=jnp.where(adopt, FOLLOWER, st.role))
-            st, granted = grant_vote(
-                st, cand_last, cand_lterm, cand.term,
-                jnp.full((g,), slot, jnp.int32), active=mj)
-            # granting a vote resets the election timer (the reference
-            # resets on any message from a legitimate candidate)
-            st = st._replace(elapsed=jnp.where(granted, 0, st.elapsed))
-            self.states[peer] = st
-            votes += np.asarray(granted).astype(np.int64)
-
-        won = mask & (votes >= (m // 2 + 1))
-        wj = jnp.asarray(won)
-        # winners become leader; note the reference appends an empty
-        # entry on becoming leader (raft.go:329-348) so the new term
-        # has a committable entry — replicated via the normal path
-        cand = cand._replace(
-            role=jnp.where(wj, LEADER, cand.role),
-            lead=jnp.where(wj, slot, cand.lead),
-            match=jnp.where(wj[:, None], 0, cand.match),
-            next_=jnp.where(wj[:, None], cand.last[:, None] + 1,
-                            cand.next_))
-        self.states[slot] = cand
-        won_np = np.asarray(wj)
+        g = self.g
+        mask = np.ones(g, bool) if mask is None else np.asarray(mask, bool)
+        dense = self._no_drop if not drop else \
+            jnp.asarray(_drop_dense(drop, self.m, g))
+        states, won = _fused_campaign(
+            tuple(self.states), jnp.asarray(mask), dense, slot=slot)
+        self.states = list(states)
+        won_np = np.asarray(won)
         self.leader = np.where(won_np, slot, self.leader).astype(np.int32)
         if won_np.any():
             # Entries beyond the winner's last were never committed
             # (Raft safety: committed entries survive elections), so a
             # deposed leader's payloads at those indices are garbage
             # the new term may overwrite — drop them.
-            winner_last = np.asarray(cand.last)
+            winner_last = np.asarray(self.states[slot].last)
             for gi in np.nonzero(won_np)[0]:
                 p = self.payloads[gi]
                 cut = int(winner_last[gi])
@@ -134,10 +330,11 @@ class MultiRaft:
                     self.payloads[gi] = {
                         k: v for k, v in p.items() if k <= cut}
             # the becoming-leader empty entry (raft.go:329-348)
-            self.propose(np.where(won_np, 1, 0).astype(np.int32))
+            self.propose(np.where(won_np, 1, 0).astype(np.int32),
+                         drop=drop)
         return won_np
 
-    # -- the replication hot path ---------------------------------------
+    # -- the replication hot path (one fused device call per round) ------
 
     def propose(self, n_new: np.ndarray,
                 data: list[list[bytes]] | None = None,
@@ -145,42 +342,29 @@ class MultiRaft:
         """Append ``n_new[g]`` proposals to each group's leader and
         run one full replicate→respond→commit round.  Returns the
         per-group count of newly committed entries."""
-        g, m = self.g, self.m
-        lead = self.leader
+        g = self.g
         n_new = np.asarray(n_new, np.int32)
-
-        # capture append bases from members that really ARE leader
-        # (a deposed member may still be in self.leader briefly)
-        valid = np.zeros(g, bool)
-        base = np.zeros(g, np.int64)
-        for slot in range(m):
-            sel = lead == slot
-            if not sel.any():
-                continue
-            st = self.states[slot]
-            is_lead = sel & (np.asarray(st.role) == LEADER)
-            valid |= is_lead
-            base[is_lead] = np.asarray(st.last)[is_lead]
-
-        for slot in range(m):
-            sel = jnp.asarray(lead == slot)
-            if not bool(np.asarray(sel).any()):
-                continue
-            st = self.states[slot]
-            st, err = leader_append(
-                st, jnp.where(sel, jnp.asarray(n_new), 0),
-                jnp.full((g,), slot, jnp.int32), active=sel)
-            if bool(np.asarray(err).any()):
-                raise OverflowError("log capacity exceeded; compact")
-            self.states[slot] = st
-
-        # payloads recorded only after the appends landed, keyed from
-        # the validated leader's pre-append last index
+        dense = self._no_drop if not drop else \
+            jnp.asarray(_drop_dense(drop, self.m, g))
+        states, newly, valid, base, overflow, conflict = _fused_round(
+            tuple(self.states), jnp.asarray(self.leader),
+            jnp.asarray(n_new), dense, e=self.e)
+        self.states = list(states)
+        self.errors["overflow"] = np.asarray(overflow)
+        self.errors["conflict"] = np.asarray(conflict)
+        # payloads recorded only for groups whose addressed member
+        # really IS leader (a deposed member may linger in
+        # self.leader), keyed from its pre-append last index; the
+        # assignment arrays are kept for callers that key their own
+        # bookkeeping (the multi-group server's wait registry)
+        self.last_valid = np.asarray(valid)
+        self.last_base = np.asarray(base)
         if data is not None:
-            for gi in np.nonzero(valid)[0]:
+            for gi in np.nonzero(self.last_valid)[0]:
                 for j, blob in enumerate(data[gi][:int(n_new[gi])]):
-                    self.payloads[gi][int(base[gi]) + 1 + j] = blob
-        return self.replicate(drop=drop)
+                    self.payloads[gi][int(self.last_base[gi]) + 1 + j] \
+                        = blob
+        return np.asarray(newly)
 
     def replicate(self, drop=None) -> np.ndarray:
         """One replication round for every group: leaders send their
@@ -193,103 +377,39 @@ class MultiRaft:
         per-edge lossy fake network (raft_test.go:1258-1287).  Dropped
         appends are simply retried on a later round: the protocol's
         fire-and-forget contract (server.go:202-206)."""
-        g, m, e = self.g, self.m, self.e
-        drop = drop or {}
-        commits_before = self._commit_vector()
+        return self.propose(np.zeros(self.g, np.int32), drop=drop)
 
-        for slot in range(m):
-            sel_np = self.leader == slot
-            if not sel_np.any():
-                continue
-            sel = jnp.asarray(sel_np)
-            lst = self.states[slot]
-            for peer in range(m):
-                if peer == slot:
-                    continue
-                pst = self.states[peer]
-                # window: follower's next.. min(next+E-1, leader last)
-                nxt = jnp.take_along_axis(
-                    lst.next_, jnp.full((g, 1), peer, jnp.int32),
-                    axis=1)[:, 0]
-                # followers at a lower term adopt the leader's
-                # (raft.go:388-396); stale leaders don't send
-                send = sel & (lst.term >= pst.term) & \
-                    (lst.role == LEADER)
-                if (slot, peer) in drop:
-                    send = send & ~jnp.asarray(drop[(slot, peer)])
-                adopt = send & (lst.term > pst.term)
-                pst = pst._replace(
-                    term=jnp.where(adopt, lst.term, pst.term),
-                    vote=jnp.where(adopt, -1, pst.vote),
-                    role=jnp.where(send, FOLLOWER, pst.role),
-                    lead=jnp.where(send, slot, pst.lead))
-                # slow follower fell behind the leader's compaction
-                # point: send a snapshot instead (raft.go:207-209,
-                # needSnapshot :556); the follower's log collapses to
-                # the leader's offset entry and normal appends resume
-                needs_snap = send & (nxt <= lst.offset) & \
-                    (lst.offset > 0)
-                if bool(np.asarray(needs_snap).any()):
-                    snap_term = term_at(lst.log_term, lst.offset,
-                                        lst.last, lst.offset)
-                    follower_commit = pst.commit
-                    pst, installed = restore_snapshot(
-                        pst, lst.offset, snap_term,
-                        commit=jnp.minimum(lst.commit, lst.offset),
-                        active=needs_snap)
-                    # installed lanes ack the snapshot index; lanes
-                    # that rejected (commit already past it) reply
-                    # with their commit, repairing the leader's stale
-                    # next_ without any truncation (raft.go:419-424)
-                    peer_v = jnp.full((g,), peer, jnp.int32)
-                    lst = progress_update(
-                        lst, peer_v, lst.offset, active=installed)
-                    rejected = needs_snap & ~installed
-                    lst = progress_update(
-                        lst, peer_v, follower_commit, active=rejected)
-                    nxt = jnp.where(
-                        installed, lst.offset + 1,
-                        jnp.where(rejected, follower_commit + 1, nxt))
+    # -- membership change (raft.go:376-387,431-435 batched) -------------
 
-                prev_idx = nxt - 1
-                prev_term = term_at(lst.log_term, lst.offset, lst.last,
-                                    prev_idx)
-                n_send = jnp.clip(lst.last - prev_idx, 0, e)
-                ent_idx = prev_idx[:, None] + 1 + \
-                    jnp.arange(e, dtype=jnp.int32)
-                ent_terms = term_at(lst.log_term, lst.offset, lst.last,
-                                    ent_idx)
-                pst, ok, err = maybe_append(
-                    pst, prev_idx, prev_term, ent_terms, n_send,
-                    lst.commit, active=send)
-                if bool(np.asarray(err).any()):
-                    raise RuntimeError("append conflict below commit")
-                # any append from the legitimate leader resets the
-                # follower's election timer (otherwise every follower
-                # would depose a healthy leader each `timeout` ticks)
-                pst = pst._replace(
-                    elapsed=jnp.where(send, 0, pst.elapsed))
-                self.states[peer] = pst
-                # msgAppResp: success → progress update; reject →
-                # decrement next (raft.go:464-470 batched); the
-                # response direction can be dropped independently
-                resp_ok = send
-                if (peer, slot) in drop:
-                    resp_ok = resp_ok & ~jnp.asarray(drop[(peer, slot)])
-                acked = prev_idx + n_send
-                lst = progress_update(lst, jnp.full((g,), peer,
-                                                    jnp.int32),
-                                      acked, active=resp_ok & ok)
-                reject = resp_ok & ~ok
-                if bool(np.asarray(reject).any()):
-                    onehot = jnp.arange(m) == peer
-                    dec = jnp.maximum(nxt - 1, 1)
-                    lst = lst._replace(next_=jnp.where(
-                        reject[:, None] & onehot[None, :],
-                        dec[:, None], lst.next_))
-            lst = maybe_commit(lst)
-            self.states[slot] = lst
-        return self._commit_vector() - commits_before
+    def apply_conf_change(self, add: bool, slot: int,
+                          mask: np.ndarray | None = None) -> None:
+        """Apply a committed ConfChange to the masked groups: every
+        co-hosted member adopts the new membership at once (the
+        reference applies the committed entry at each member's server
+        loop, server.go:542-559; co-hosted members share the host, so
+        the fan-out is one batched update per member).
+
+        Grow: the new slot starts empty (match 0, next last+1) and is
+        caught up by normal replication — or the snapshot path if the
+        leader already compacted.  Shrink: the removed slot's edges
+        mask off, its stale match can't form quorums, and a removed
+        leader steps down (its groups elect fresh on the next
+        timeout).  The CALLER is responsible for proposing the change
+        through the log and applying it only once committed (the
+        server layer's job, as in the reference)."""
+        g = self.g
+        mask = np.ones(g, bool) if mask is None else np.asarray(mask, bool)
+        mj = jnp.asarray(mask)
+        addv = jnp.full((g,), bool(add))
+        slotv = jnp.full((g,), slot, jnp.int32)
+        for s in range(self.m):
+            self.states[s] = conf_change_batch(
+                self.states[s], addv, slotv,
+                jnp.full((g,), s, jnp.int32), active=mj)
+        if not add:
+            # deposed-by-removal groups lose their routing entry too
+            self.leader = np.where(mask & (self.leader == slot), -1,
+                                   self.leader).astype(np.int32)
 
     def mark_applied(self, upto: np.ndarray) -> None:
         """The host consumer declares it has applied entries up to
@@ -309,16 +429,19 @@ class MultiRaft:
         server.go:313-316 + log.go:161); payloads below the
         compaction point are dropped from the host ring.  Call
         :meth:`mark_applied` first — compaction never outruns what
-        the consumer declared applied."""
+        the consumer declared applied.  Out-of-bounds lanes skip
+        compaction (surfaced per-group in ``errors["compact_oob"]``,
+        never batch-fatal)."""
+        oob = np.zeros(self.g, bool)
         for slot in range(self.m):
             st = self.states[slot]
             idx = st.applied
             if upto is not None:
                 idx = jnp.minimum(idx, jnp.asarray(upto, jnp.int32))
             st, err = compact_batch(st, jnp.maximum(idx, st.offset))
-            if bool(np.asarray(err).any()):
-                raise RuntimeError("compact out of bounds")
+            oob |= np.asarray(err)
             self.states[slot] = st
+        self.errors["compact_oob"] = oob
         cut = np.min(np.stack(
             [np.asarray(st.offset) for st in self.states]), axis=0)
         for gi in range(self.g):
@@ -328,14 +451,15 @@ class MultiRaft:
                 self.payloads[gi] = {k: v for k, v in p.items()
                                      if k >= c}
 
-    def tick(self) -> None:
-        """Advance every member's timers; campaign where they fire."""
+    def tick(self, drop=None) -> None:
+        """Advance every member's timers; campaign where they fire.
+        ``drop`` faults apply to the resulting vote traffic too."""
         for slot in range(self.m):
             st, elect, _beat = tick_batch(self.states[slot])
             self.states[slot] = st
             fire = np.asarray(elect)
             if fire.any():
-                self.campaign(slot, fire)
+                self.campaign(slot, fire, drop=drop)
 
     # -- views -----------------------------------------------------------
 

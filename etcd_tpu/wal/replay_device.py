@@ -33,6 +33,7 @@ import numpy as np
 
 from .. import native
 from ..wire import Entry, HardState
+from ..wire.proto import ProtoError
 from .errors import (
     CRCMismatchError,
     FileNotFoundError_,
@@ -83,10 +84,47 @@ class EntryBlock:
         return [self.entry(i) for i in range(len(self))]
 
 
+def _parse_record_span(raw: bytes, base: int, rlen: int):
+    """Parse one Record in place, returning exact field positions.
+
+    Walks the proto fields directly (the field loop of
+    ``wire.proto.Record.unmarshal``) so the returned data span is the
+    byte range the encoder actually wrote — a substring search can
+    false-match payload bytes that also occur inside the type/crc
+    varint envelope, which is how the native scanner avoids it too
+    (walscan.cc tracks offsets while decoding).
+
+    Returns ``(type, crc, data_off_abs, data_len)``.
+    """
+    from ..wire.proto import _expect_wt, _skip_field, uvarint
+
+    end = base + rlen
+    rtype = crc = 0
+    doff, dlen = base, 0
+    pos = base
+    while pos < end:
+        tag, pos = uvarint(raw, pos)
+        fnum, wt = tag >> 3, tag & 7
+        if fnum == 1:
+            _expect_wt(fnum, wt, 0)  # corrupt framing aborts, never
+            rtype, pos = uvarint(raw, pos)  # masks (proto.py parity)
+        elif fnum == 2:
+            _expect_wt(fnum, wt, 0)
+            crc, pos = uvarint(raw, pos)
+        elif fnum == 3:
+            _expect_wt(fnum, wt, 2)
+            dlen, pos = uvarint(raw, pos)
+            doff = pos
+            pos += dlen
+        else:
+            pos = _skip_field(raw, pos, wt)
+        if pos > end:
+            raise WALError("record field overruns frame")
+    return rtype, crc, doff, dlen
+
+
 def _scan_python(blob: np.ndarray):
     """Pure-Python framing fallback mirroring native.wal_scan."""
-    from ..wire import Record
-
     raw = blob.tobytes()
     pos, n = 0, len(raw)
     types, crcs, doffs, dlens, eidxs, eterms, etypes = \
@@ -98,16 +136,13 @@ def _scan_python(blob: np.ndarray):
         pos += 8
         if rlen < 0 or rlen > n - pos:
             raise WALError("truncated record")
-        rec = Record.unmarshal(raw[pos:pos + rlen])
-        data = rec.data or b""
-        # find the data span inside the record for offset bookkeeping
-        doff = raw.index(data, pos, pos + rlen) if data else pos
-        types.append(rec.type)
-        crcs.append(rec.crc)
+        rtype, crc, doff, dlen = _parse_record_span(raw, pos, rlen)
+        types.append(rtype)
+        crcs.append(crc)
         doffs.append(doff)
-        dlens.append(len(data))
-        if rec.type == ENTRY_TYPE and data:
-            e = Entry.unmarshal(data)
+        dlens.append(dlen)
+        if rtype == ENTRY_TYPE and dlen:
+            e = Entry.unmarshal(raw[doff:doff + dlen])
             eidxs.append(e.index)
             eterms.append(e.term)
             etypes.append(e.type)
@@ -129,15 +164,6 @@ def _pad_rows_numpy(blob, doff, dlen, width):
         o, l = int(doff[i]), int(dlen[i])
         out[i, width - l:] = blob[o:o + l]
     return out
-
-
-def _width_class(w: int) -> int:
-    """Quantized row width: multiples of 128 up to 2 KiB, then powers
-    of two.  Bounds the set of compiled batch shapes (~27 lifetime)
-    while keeping padding waste small for the common record sizes."""
-    if w <= 2048:
-        return max(64, -(-w // 128) * 128)
-    return 1 << (w - 1).bit_length()
 
 
 def verify_chain_device(blob: np.ndarray, types, crcs, doff, dlen,
@@ -187,7 +213,10 @@ def verify_chain_device(blob: np.ndarray, types, crcs, doff, dlen,
     for w in np.unique(wcls):
         w = int(w)
         rows_idx = np.nonzero(wcls == w)[0]
-        rpc = max(256, min(chunk_rows, byte_budget // w))
+        # byte_budget caps host-chunk bytes even for multi-MiB width
+        # classes (whose XLA bit expansion is ~8x the chunk size); the
+        # floor is 1 row, never a fixed row count
+        rpc = max(1, min(chunk_rows, byte_budget // w))
         # don't build a mostly-padding chunk for a tiny class; pow2
         # quantization keeps the compiled-shape count bounded
         rpc = min(rpc, max(8, 1 << (rows_idx.size - 1).bit_length()))
@@ -245,9 +274,21 @@ def read_all_device(dirpath: str, index: int = 0
     blob = np.concatenate(blobs) if len(blobs) > 1 else blobs[0]
 
     if native.available():
-        types, crcs, doff, dlen, eidx, eterm, etype = native.wal_scan(blob)
+        try:
+            types, crcs, doff, dlen, eidx, eterm, etype = \
+                native.wal_scan(blob)
+        except native.NativeError as e:
+            # error-type parity with the host path: WAL corruption is
+            # a WALError regardless of which scanner found it
+            if "crc" in str(e):
+                raise CRCMismatchError(str(e)) from e
+            raise WALError(str(e)) from e
     else:
-        types, crcs, doff, dlen, eidx, eterm, etype = _scan_python(blob)
+        try:
+            types, crcs, doff, dlen, eidx, eterm, etype = \
+                _scan_python(blob)
+        except ProtoError as e:  # same parity for the python scanner
+            raise WALError(str(e)) from e
 
     known = np.isin(types, (METADATA_TYPE, ENTRY_TYPE, STATE_TYPE,
                             CRC_TYPE))

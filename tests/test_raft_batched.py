@@ -70,12 +70,12 @@ def test_maybe_append_parity():
         ent_terms = np.sort(ent_terms, axis=1)  # terms non-decreasing
         leader_commit = rng.integers(0, 30, size=G).astype(np.int32)
 
-        st2, ok, err = batched.maybe_append(
+        st2, ok, errc, erro = batched.maybe_append(
             st, jnp.asarray(prev_idx), jnp.asarray(prev_term),
             jnp.asarray(ent_terms), jnp.asarray(n_ents),
             jnp.asarray(leader_commit))
         ok = np.asarray(ok)
-        err = np.asarray(err)
+        err = np.asarray(errc) | np.asarray(erro)
         lt2 = np.asarray(st2.log_term)
         last2 = np.asarray(st2.last)
         commit2 = np.asarray(st2.commit)

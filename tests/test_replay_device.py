@@ -204,6 +204,110 @@ def test_unknown_record_type_rejected(tmp_path):
     w.close()
 
 
+def test_python_scan_exact_offsets():
+    """Data-span offsets must come from proto field positions, not a
+    substring search: a payload byte-equal to part of the type/crc
+    envelope (here b"\\x01" == the metadata type varint's value byte)
+    would false-match earlier in the record."""
+    import struct
+    from etcd_tpu.wal.replay_device import _scan_python
+    from etcd_tpu.wire import Record
+
+    recs = [Record(type=1, crc=0x01, data=b"\x01"),        # collides
+            Record(type=2, crc=0x1A2B, data=b"\x10\x1a"),  # tag bytes
+            Record(type=1, crc=7, data=b"")]               # empty data
+    raw = bytearray()
+    offsets = []
+    for r in recs:
+        m = r.marshal()
+        raw += struct.pack("<q", len(m))
+        # the data field is always last in our encoder: its span ends
+        # at the record end
+        offsets.append(len(raw) + len(m) - len(r.data))
+        raw += m
+    blob = np.frombuffer(bytes(raw), dtype=np.uint8).copy()
+    types, crcs, doff, dlen, *_ = _scan_python(blob)
+    assert [int(t) for t in types] == [1, 2, 1]
+    assert [int(c) for c in crcs] == [0x01, 0x1A2B, 7]
+    assert [int(l) for l in dlen] == [1, 2, 0]
+    assert [int(o) for o in doff[:2]] == offsets[:2]
+    # round-trip: the span re-reads the exact payload bytes
+    for i, r in enumerate(recs):
+        o, l = int(doff[i]), int(dlen[i])
+        assert blob[o:o + l].tobytes() == r.data
+
+
+def test_python_scan_field_overrun():
+    """A data-field length running past the frame is corruption."""
+    import struct
+    from etcd_tpu.wal.replay_device import _scan_python
+    from etcd_tpu.wal.errors import WALError
+
+    # record claims an 8-byte data field but the frame ends after 2
+    body = bytes([0x08, 0x01, 0x10, 0x00, 0x1A, 0x08]) + b"xx"
+    raw = struct.pack("<q", len(body)) + body
+    blob = np.frombuffer(raw, dtype=np.uint8).copy()
+    with pytest.raises(WALError, match="overruns"):
+        _scan_python(blob)
+
+
+def test_python_scan_wrong_wiretype_aborts():
+    """A known field with the wrong wire type is corrupt framing and
+    must abort (proto.py _expect_wt parity), never be skipped."""
+    import struct
+    from etcd_tpu.wal.replay_device import _scan_python
+    from etcd_tpu.wire.proto import ProtoError
+
+    # field 1 (type) sent length-delimited instead of varint
+    body = bytes([0x0A, 0x01, 0x01, 0x10, 0x00])
+    raw = struct.pack("<q", len(body)) + body
+    blob = np.frombuffer(raw, dtype=np.uint8).copy()
+    with pytest.raises(ProtoError):
+        _scan_python(blob)
+
+
+def test_native_error_maps_to_walerror(tmp_path, monkeypatch):
+    """--storage-backend=tpu corruption surfaces as WALError, not
+    NativeError (error-type parity with the host path)."""
+    from etcd_tpu.wal.errors import WALError
+
+    d = tmp_path / "wal"
+    _write_wal(d, n_entries=3, cuts=())
+    monkeypatch.setattr(native, "available", lambda: True)
+    for msg, exc in (("truncated stream", WALError),
+                     ("crc mismatch", CRCMismatchError)):
+        def raiser(blob, _msg=msg):
+            raise native.NativeError(_msg)
+        monkeypatch.setattr(native, "wal_scan", raiser)
+        with pytest.raises(exc, match=msg.split()[0]):
+            read_all_device(str(d), 0)
+
+
+def test_big_record_small_byte_budget(tmp_path):
+    """Width classes above byte_budget chunk down to few-row (even
+    1-row) batches instead of flooring at 256 rows of multi-MiB
+    padding (advisor finding: host-chunk OOM risk)."""
+    from etcd_tpu.wal.replay_device import verify_chain_device
+
+    d = tmp_path / "wal"
+    w = WAL.create(str(d), b"m")
+    w.save_entry(Entry(term=1, index=0, data=b"B" * (130 << 10)))
+    w.save_entry(Entry(term=1, index=1, data=b"C" * (130 << 10)))
+    w.save_entry(Entry(term=1, index=2, data=b"s" * 64))
+    w.sync()
+    w.close()
+    fname = sorted(os.listdir(d))[0]
+    blob = np.fromfile(d / fname, dtype=np.uint8)
+    types, crcs, doff, dlen, *_ = native.wal_scan(blob) \
+        if native.available() else __import__(
+            "etcd_tpu.wal.replay_device",
+            fromlist=["_scan_python"])._scan_python(blob)
+    # budget (128 KiB) < one row's width class (256 KiB): rpc must
+    # clamp to 1 row, not floor at 256 rows of padding
+    verify_chain_device(blob, types, crcs, doff, dlen,
+                        byte_budget=1 << 17)
+
+
 def test_mixed_width_records(tmp_path):
     """One huge record must not inflate every row's padding: width
     classes keep the batch allocatable and the chain still verifies."""
