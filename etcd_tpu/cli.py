@@ -83,6 +83,14 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["auto", "tpu", "host"],
                    help="Data-plane backend: tpu uses the device replay/"
                         "hash kernels when a device is present")
+    p.add_argument("--cohosted-groups", type=int, default=0,
+                   help="Run the co-hosted multi-group server: N raft "
+                        "groups batched through the device data plane "
+                        "behind one /v2/keys endpoint (namespace = "
+                        "first path segment). 0 = classic single-group "
+                        "mode")
+    p.add_argument("--cohosted-members", type=int, default=3,
+                   help="Members per co-hosted group (default 3)")
     # v0.4.6 back-compat (main.go:87-98)
     p.add_argument("--addr", default=None,
                    help="DEPRECATED: Use --advertise-client-urls instead.")
@@ -153,9 +161,42 @@ def main(argv: list[str] | None = None) -> int:
     else:
         cluster.set_from_string(args.initial_cluster)
 
-    if args.proxy == PROXY_VALUE_OFF:
-        return start_etcd(args, cluster, explicit)
-    return start_proxy(args, cluster, explicit)
+    if args.proxy != PROXY_VALUE_OFF:
+        return start_proxy(args, cluster, explicit)
+    if args.cohosted_groups > 0:
+        return start_multigroup(args, explicit)
+    return start_etcd(args, cluster, explicit)
+
+
+def start_multigroup(args, explicit: set[str]) -> int:
+    """Co-hosted multi-group mode: G groups' consensus runs as one
+    batched device data plane behind the standard client API
+    (server/multigroup.py — no reference counterpart; the reference
+    is one group per process)."""
+    from .server.multigroup import MultiGroupServer
+
+    data_dir = args.data_dir or f"{args.name}_multigroup_data"
+    os.makedirs(data_dir, mode=0o700, exist_ok=True)
+    s = MultiGroupServer(
+        data_dir, g=args.cohosted_groups, m=args.cohosted_members,
+        name=args.name, snap_count=args.snapshot_count,
+        storage_backend=args.storage_backend)
+    s.start()
+
+    client_tls = TLSInfo(args.cert_file, args.key_file, args.ca_file)
+    cors = parse_cors(args.cors) if args.cors else None
+    ch = make_client_handler(s, cors=cors)
+    lcurls = urls_from_flags(args, "listen_client_urls", "bind_addr",
+                             explicit, client_tls.empty())
+    for u in lcurls:
+        host, port = _split_hostport(u)
+        serve(ch, host, port, new_listener_context(client_tls))
+        log.info("Listening for client requests on %s "
+                 "(%d co-hosted groups x %d members)",
+                 u, args.cohosted_groups, args.cohosted_members)
+
+    _block_forever()
+    return 0
 
 
 def start_etcd(args, cluster: Cluster, explicit: set[str]) -> int:

@@ -145,16 +145,19 @@ def _fused_round(states, leader, n_new, drop, e):
             # installed lanes ack the snapshot index; lanes that
             # rejected (commit already past it) reply with their
             # commit, repairing the leader's stale next_ without any
-            # truncation (raft.go:419-424)
+            # truncation (raft.go:419-424).  Both acks ride the
+            # response edge — droppable like any msgAppResp.
+            snap_ack = ~drop[peer, slot]
             peer_v = jnp.full((g,), peer, jnp.int32)
             lst = progress_update(lst, peer_v, lst.offset,
-                                  active=installed)
+                                  active=installed & snap_ack)
             rejected = needs_snap & ~installed
             lst = progress_update(lst, peer_v, follower_commit,
-                                  active=rejected)
+                                  active=rejected & snap_ack)
             nxt = jnp.where(
-                installed, lst.offset + 1,
-                jnp.where(rejected, follower_commit + 1, nxt))
+                installed & snap_ack, lst.offset + 1,
+                jnp.where(rejected & snap_ack, follower_commit + 1,
+                          nxt))
 
             prev_idx = nxt - 1
             prev_term = term_at(lst.log_term, lst.offset, lst.last,

@@ -1,0 +1,487 @@
+"""Co-hosted multi-group server: G raft groups behind the serving seams.
+
+The reference binds ONE raft group to one process
+(etcdserver/server.go:191-218); its in-process cluster tests wire N
+real servers through an injected send function
+(server_test.go:370-447).  This module is that pattern generalized the
+TPU-first way: ALL M members of G co-hosted groups live in one
+process, consensus for every group advances in ONE fused device round
+per batch (raft/multiraft.py), and the serving seams are the same ones
+the reference exposes —
+
+- **Request path**: ``do(Request)`` routes a client write to its
+  group (first path segment → group, sha1-hashed like member IDs,
+  cluster.py) and blocks on the wait registry until the entry commits
+  and applies (server.go:337-380's propose→wait pattern).
+- **Storage seam**: one WAL stream per server (wal/wal.py — same
+  record framing, device-replayable as a single batch) multiplexing
+  all groups via :class:`~etcd_tpu.wire.GroupEntry` envelopes, plus
+  commit-frontier markers; snapshots via the standard Snapshotter.
+  Entries are durable BEFORE client acks (the Ready contract,
+  node.go:41-60, translated to the co-hosted fate-sharing model).
+- **Store seam**: one shared KV tree; group namespaces are path
+  prefixes, so watches/TTLs/stats work unchanged.
+
+Durability model (differs from per-member WALs, deliberately): the M
+co-hosted members share process fate, so the durability unit is the
+*server*, not the member — one WAL records appended entries and the
+per-group commit frontier; restart replays committed prefixes and
+re-elects.  Entries beyond the last persisted frontier were never
+client-acked and are dropped on restart (timeout semantics permit
+either outcome).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import queue
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..snap import NoSnapshotError, Snapshotter
+from ..store import Store
+from ..utils.wait import Wait
+from ..wal import WAL, exist as wal_exist
+from ..wire import Entry, GroupEntry, HardState, Snapshot
+from ..wire.requests import Info, Request
+from .cluster import ClusterStore
+from .server import (
+    DEFAULT_SNAP_COUNT,
+    Response,
+    ServerStoppedError,
+    _replay_wal,
+    apply_request_to_store,
+    gen_id,
+)
+from .stats import LeaderStats, ServerStats
+
+log = logging.getLogger(__name__)
+
+TICK_INTERVAL = 0.1        # reference server.go:182
+
+
+def group_of(path: str, g: int) -> int:
+    """Deterministic namespace → group routing: sha1 of the first
+    path segment (the same hash family as member IDs, member.go:37)."""
+    ns = path.strip("/").split("/", 1)[0]
+    h = hashlib.sha1(ns.encode()).digest()
+    return int.from_bytes(h[:8], "big") % g
+
+
+@dataclass
+class _Pending:
+    req: Request
+    data: bytes
+    id: int
+    retries: int = 0
+
+
+class MultiGroupServer:
+    """G co-hosted raft groups serving one namespaced KV tree."""
+
+    def __init__(self, data_dir: str, *, g: int = 64, m: int = 3,
+                 cap: int = 1024, name: str = "multigroup",
+                 snap_count: int = DEFAULT_SNAP_COUNT,
+                 storage_backend: str = "auto",
+                 max_batch_ents: int = 32,
+                 tick_interval: float = TICK_INTERVAL):
+        from ..raft.multiraft import MultiRaft
+
+        self.g, self.m = g, m
+        self.name = name
+        self.snap_count = snap_count or DEFAULT_SNAP_COUNT
+        self.backend = storage_backend
+        self.tick_interval = tick_interval
+        self.id = int.from_bytes(
+            hashlib.sha1(name.encode()).digest()[:8], "big") & (2**63 - 1)
+
+        self.store = Store()
+        self.w = Wait()
+        self.done = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._queue: queue.Queue[_Pending | None] = queue.Queue()
+        self._requeue: list[deque[_Pending]] = [deque() for _ in range(g)]
+
+        self.server_stats = ServerStats(name, self.id)
+        self.leader_stats = LeaderStats(self.id)
+        self.cluster_store = ClusterStore(self.store)
+
+        os.makedirs(data_dir, mode=0o700, exist_ok=True)
+        self._snapdir = os.path.join(data_dir, "snap")
+        os.makedirs(self._snapdir, mode=0o700, exist_ok=True)
+        self._waldir = os.path.join(data_dir, "wal")
+        crc_fn = None
+        if storage_backend != "host":
+            try:
+                from ..ops.crc_kernel import auto_crc32c
+
+                crc_fn = auto_crc32c
+            except ImportError:
+                pass
+        self.ss = Snapshotter(self._snapdir, crc_fn=crc_fn)
+
+        self.seq = 0                      # global WAL entry sequence
+        self.applied = np.zeros(g, np.int64)   # per-group applied idx
+        self.raft_index = 0               # applied entries total
+        self.raft_term = 0
+        self._snapi = 0                   # raft_index at last snapshot
+
+        if wal_exist(self._waldir):
+            self._restart(cap, max_batch_ents)
+        else:
+            self.mr = MultiRaft(g, m, cap,
+                                max_batch_ents=max_batch_ents)
+            self.wal = WAL.create(self._waldir,
+                                  Info(id=self.id).marshal())
+            # seq-0 zero-frontier marker: WAL replay requires entry
+            # indices contiguous from the open index (wal.go:171-175)
+            zero = np.zeros(g, np.int32).tobytes()
+            self.wal.save(HardState(), [Entry(
+                index=0, term=0,
+                data=GroupEntry(kind=1, payload=zero + zero)
+                .marshal())])
+
+    # -- bootstrap / restart ---------------------------------------------
+
+    def _restart(self, cap: int, max_batch_ents: int) -> None:
+        """Snapshot + WAL replay → store + re-seeded consensus state.
+
+        The WAL is replayed through the backend-honoring seam
+        (server.py:_replay_wal — device batch replay when it pays);
+        only entries at or below the last persisted commit frontier
+        apply (never-acked tails drop); every member re-seeds with the
+        committed log's compacted form and fresh elections start above
+        the replayed term.
+        """
+        from ..raft.multiraft import MultiRaft
+
+        g = self.g
+        frontier = np.zeros(g, np.int64)
+        terms = np.zeros(g, np.int64)
+        snap_index = 0
+        try:
+            snap = self.ss.load()
+        except NoSnapshotError:
+            snap = None
+        applied_total = 0
+        if snap is not None:
+            blob = json.loads(snap.data.decode())
+            self.store.recovery(blob["store"].encode())
+            frontier = np.asarray(blob["frontier"], np.int64)
+            terms = np.asarray(blob["terms"], np.int64)
+            snap_index = blob["seq"]
+            applied_total = blob.get("applied_total", 0)
+            log.info("multigroup: restart from snapshot seq=%d",
+                     snap_index)
+        snap_frontier = frontier.copy()
+        # an empty post-snapshot tail must not reset the sequence
+        self.seq = snap_index
+
+        self.wal, md, hard_state, ents = _replay_wal(
+            self._waldir, snap_index, self.backend)
+        info = Info.unmarshal(md or b"")
+        if info.id != self.id:
+            raise RuntimeError(
+                f"unexpected server id {info.id:x}, want {self.id:x}")
+
+        # pass 1: last record wins per (group, gindex); frontier =
+        # last marker
+        winners: dict[tuple[int, int], int] = {}
+        parsed: list[GroupEntry] = []
+        for k, e in enumerate(ents):
+            ge = GroupEntry.unmarshal(e.data)
+            parsed.append(ge)
+            if ge.kind == 0:
+                winners[(ge.group, ge.gindex)] = k
+            elif ge.kind == 1:
+                v = np.frombuffer(ge.payload, np.int32)
+                frontier = v[:g].astype(np.int64)
+                terms = v[g:2 * g].astype(np.int64)
+            self.seq = max(self.seq, e.index)
+
+        # pass 2: apply committed winners in stream order
+        applied_n = 0
+        for k, ge in enumerate(parsed):
+            if ge.kind != 0 or winners.get((ge.group, ge.gindex)) != k:
+                continue
+            if not (snap_frontier[ge.group] < ge.gindex
+                    <= frontier[ge.group]):
+                continue
+            if ge.payload:
+                r = Request.unmarshal(ge.payload)
+                apply_request_to_store(self.store, r)
+            applied_n += 1
+
+        self.applied = frontier.copy()
+        self.raft_index = applied_total + applied_n
+        self.raft_term = int(terms.max()) if g else 0
+        self._snapi = self.raft_index
+
+        # re-seed consensus: every member holds the committed log in
+        # compacted form (offset = last = commit = applied = frontier,
+        # slot 0 carries the frontier term for match checks)
+        import jax.numpy as jnp
+
+        mr = MultiRaft(g, self.m, cap, max_batch_ents=max_batch_ents)
+        fr = jnp.asarray(frontier, jnp.int32)
+        tm = jnp.asarray(terms, jnp.int32)
+        slot0 = jnp.zeros((g, cap), jnp.int32).at[:, 0].set(tm)
+        for s in range(self.m):
+            st = mr.states[s]
+            mr.states[s] = st._replace(
+                term=tm, offset=fr, last=fr, commit=fr, applied=fr,
+                log_term=slot0)
+        self.mr = mr
+        log.info("multigroup: replayed %d entries, %d applied, "
+                 "max term %d", len(ents), applied_n, self.raft_term)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        # bootstrap election + one replication round BEFORE serving:
+        # the first fused-round jit compile (seconds) must not eat
+        # into early clients' 500ms request timeouts
+        if (self.mr.leader < 0).any():
+            self.mr.campaign(0, mask=self.mr.leader < 0)
+        self._absorb_commits({})
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.done.set()
+        self._queue.put(None)  # wake the loop
+        if self._thread is not None \
+                and self._thread is not threading.current_thread():
+            self._thread.join(timeout=10)
+        self.wal.close()
+
+    # -- client request path ----------------------------------------------
+
+    def do(self, r: Request, timeout: float | None = None) -> Response:
+        """The serving seam (server.go:337-380): writes and quorum
+        reads go through their group's consensus; plain GETs and
+        watches serve from the shared store."""
+        if r.id == 0:
+            raise ValueError("r.id cannot be 0")
+        if r.method == "GET" and r.quorum:
+            r.method = "QGET"
+        if r.method in ("POST", "PUT", "DELETE", "QGET"):
+            ch = self.w.register(r.id)
+            self._queue.put(_Pending(req=r, data=r.marshal(), id=r.id))
+            try:
+                x = ch.get(timeout=timeout)
+            except queue.Empty:
+                self.w.trigger(r.id, None)  # GC wait
+                raise TimeoutError("request timed out")
+            if x is None:
+                if self.done.is_set():
+                    raise ServerStoppedError()
+                raise TimeoutError("request dropped (no leader)")
+            if x.err is not None:
+                raise x.err
+            return x
+        if r.method == "GET":
+            if r.wait:
+                wc = self.store.watch(r.path, r.recursive, r.stream,
+                                      r.since)
+                return Response(watcher=wc)
+            ev = self.store.get(r.path, r.recursive, r.sorted)
+            return Response(event=ev)
+        from .server import UnknownMethodError
+
+        raise UnknownMethodError(r.method)
+
+    # -- RaftTimer --------------------------------------------------------
+
+    def index(self) -> int:
+        return self.raft_index
+
+    def term(self) -> int:
+        return self.raft_term
+
+    # -- the batched apply loop -------------------------------------------
+
+    def run(self) -> None:
+        """The co-hosted generalization of the reference run() loop
+        (server.go:247-323): drain a batch of proposals, ONE fused
+        consensus round for all groups, persist, apply, ack."""
+        mr = self.mr
+        next_tick = time.monotonic() + self.tick_interval
+
+        while not self.done.is_set():
+            batch = self._drain(timeout=min(
+                self.tick_interval,
+                max(next_tick - time.monotonic(), 0.001)))
+            if self.done.is_set():
+                break
+            now = time.monotonic()
+            if now >= next_tick:
+                if (mr.leader < 0).any():
+                    mr.tick()
+                next_tick = now + self.tick_interval
+
+            n_new = np.zeros(self.g, np.int32)
+            data: list[list[bytes]] = [[] for _ in range(self.g)]
+            items: list[list[_Pending]] = [[] for _ in range(self.g)]
+            for gi in range(self.g):
+                q = self._requeue[gi]
+                while q and len(items[gi]) < mr.e:
+                    items[gi].append(q.popleft())
+            for p in batch:
+                gi = group_of(p.req.path, self.g)
+                if len(items[gi]) >= mr.e:
+                    self._requeue[gi].append(p)
+                    continue
+                items[gi].append(p)
+            for gi in range(self.g):
+                n_new[gi] = len(items[gi])
+                data[gi] = [p.data for p in items[gi]]
+
+            if not n_new.any() and (mr.commit_index() ==
+                                    self.applied).all():
+                # idle heartbeat round only when a leader exists
+                if (mr.leader >= 0).any():
+                    mr.replicate()
+                self._absorb_commits({})
+                continue
+
+            mr.propose(n_new, data=data)
+            valid = mr.last_valid
+            base = mr.last_base
+            terms_now = np.max(np.stack(
+                [np.asarray(st.term) for st in mr.states]), axis=0)
+            assigned: dict[tuple[int, int], _Pending] = {}
+            to_persist: list[Entry] = []
+            for gi in range(self.g):
+                if not items[gi]:
+                    continue
+                if not valid[gi]:
+                    # no leader / overflow: retry a few rounds, then
+                    # fail the clients (reference: request timeout)
+                    for p in items[gi]:
+                        p.retries += 1
+                        if p.retries < 50:
+                            self._requeue[gi].append(p)
+                        else:
+                            self.w.trigger(p.id, None)
+                    continue
+                for j, p in enumerate(items[gi]):
+                    idx = int(base[gi]) + 1 + j
+                    assigned[(gi, idx)] = p
+                    self.seq += 1
+                    to_persist.append(Entry(
+                        index=self.seq, term=self.raft_term,
+                        data=GroupEntry(
+                            kind=0, group=gi, gindex=idx,
+                            gterm=int(terms_now[gi]),
+                            payload=p.data).marshal()))
+
+            self._absorb_commits(assigned, to_persist)
+            if mr.errors["overflow"].any():
+                # compaction AFTER absorb: mark_applied(self.applied)
+                # inside _absorb_commits bounds it, so committed-but-
+                # unapplied payloads are never pruned
+                mr.compact()
+
+        # server stopping: release every waiter
+        for q in self._requeue:
+            while q:
+                self.w.trigger(q.popleft().id, None)
+
+    def _drain(self, timeout: float) -> list[_Pending]:
+        """Block briefly for the first proposal, then sweep the rest
+        (request pipelining: one device round serves the batch)."""
+        out: list[_Pending] = []
+        try:
+            p = self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return out
+        if p is not None:
+            out.append(p)
+        while True:
+            try:
+                p = self._queue.get_nowait()
+            except queue.Empty:
+                return out
+            if p is not None:
+                out.append(p)
+
+    def _absorb_commits(self, assigned, to_persist=None) -> None:
+        """Persist-then-apply: newly appended entries and the commit
+        frontier go to the WAL (fsync) BEFORE any client ack — the
+        Ready contract's ordering (node.go:41-60) at batch level."""
+        mr = self.mr
+        commit = mr.commit_index().astype(np.int64)
+        newly = commit > self.applied
+        if to_persist or newly.any():
+            terms = np.zeros(self.g, np.int32)
+            if newly.any():
+                lead_terms = np.max(np.stack(
+                    [np.asarray(st.term) for st in mr.states]), axis=0)
+                terms = lead_terms.astype(np.int32)
+                self.raft_term = max(self.raft_term,
+                                     int(terms.max()))
+            frontier = GroupEntry(
+                kind=1, payload=commit.astype(np.int32).tobytes()
+                + terms.tobytes()).marshal()
+            self.seq += 1
+            ents = (to_persist or []) + [
+                Entry(index=self.seq, term=self.raft_term,
+                      data=frontier)]
+            self.wal.save(HardState(term=self.raft_term, vote=0,
+                                    commit=self.seq), ents)
+
+        if not newly.any():
+            return
+        for gi in np.nonzero(newly)[0]:
+            for idx in range(int(self.applied[gi]) + 1,
+                             int(commit[gi]) + 1):
+                payload = mr.committed_payload(int(gi), idx)
+                resp = None
+                if payload:
+                    r = Request.unmarshal(payload)
+                    resp = apply_request_to_store(self.store, r)
+                self.raft_index += 1
+                p = assigned.pop((int(gi), idx), None)
+                if p is not None:
+                    self.w.trigger(p.id, resp)
+                else:
+                    # an entry assigned in an earlier round: find its
+                    # waiter via the id embedded in the request
+                    if payload:
+                        self.w.trigger(r.id, resp)
+            self.applied[gi] = commit[gi]
+        mr.mark_applied(self.applied)
+
+        if self.raft_index - self._snapi > self.snap_count:
+            self.snapshot()
+
+    # -- snapshot / compaction --------------------------------------------
+
+    def snapshot(self) -> None:
+        """Store snapshot + frontier → snap file; compact the device
+        logs; cut the WAL (server.go:562-571 batched)."""
+        mr = self.mr
+        terms = np.max(np.stack(
+            [np.asarray(st.term) for st in mr.states]), axis=0)
+        blob = json.dumps({
+            "store": self.store.save().decode(),
+            "frontier": [int(x) for x in self.applied],
+            "terms": [int(x) for x in terms],
+            "seq": self.seq,
+            "applied_total": self.raft_index,
+        }).encode()
+        self.ss.save_snap(Snapshot(data=blob, index=self.seq,
+                                   term=self.raft_term))
+        mr.compact()
+        self.wal.cut()
+        self._snapi = self.raft_index
+        log.info("multigroup: snapshot at seq=%d (applied=%d)",
+                 self.seq, self.raft_index)

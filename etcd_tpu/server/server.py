@@ -81,6 +81,44 @@ class Response:
     err: Exception | None = None
 
 
+def apply_request_to_store(store: Store, r: Request) -> Response:
+    """Map a committed Request onto a store call (reference
+    server.go:503-540); shared by the single-group server and the
+    co-hosted multi-group server (multigroup.py)."""
+    expr = r.expiration / 1e9 if r.expiration else None
+
+    def f(call):
+        try:
+            return Response(event=call())
+        except EtcdError as e:
+            return Response(err=e)
+
+    if r.method == "POST":
+        return f(lambda: store.create(r.path, r.dir, r.val, True, expr))
+    if r.method == "PUT":
+        exists, exists_set = r.prev_exist, r.prev_exist is not None
+        if exists_set:
+            if exists:
+                return f(lambda: store.update(r.path, r.val, expr))
+            return f(lambda: store.create(r.path, r.dir, r.val, False,
+                                          expr))
+        if r.prev_index > 0 or r.prev_value != "":
+            return f(lambda: store.compare_and_swap(
+                r.path, r.prev_value, r.prev_index, r.val, expr))
+        return f(lambda: store.set(r.path, r.dir, r.val, expr))
+    if r.method == "DELETE":
+        if r.prev_index > 0 or r.prev_value != "":
+            return f(lambda: store.compare_and_delete(
+                r.path, r.prev_value, r.prev_index))
+        return f(lambda: store.delete(r.path, r.dir, r.recursive))
+    if r.method == "QGET":
+        return f(lambda: store.get(r.path, r.recursive, r.sorted))
+    if r.method == "SYNC":
+        store.delete_expired_keys(r.time / 1e9)
+        return Response()
+    return Response(err=UnknownMethodError(r.method))
+
+
 class WalSnapStorage:
     """The Storage seam (reference server.go:51-62): WAL + snapshotter
     behind one interface so the device-backed replay path can swap in."""
@@ -354,39 +392,7 @@ class EtcdServer:
     def apply_request(self, r: Request) -> Response:
         """Map a committed Request onto a store call
         (reference server.go:503-540)."""
-        expr = r.expiration / 1e9 if r.expiration else None
-
-        def f(call):
-            try:
-                return Response(event=call())
-            except EtcdError as e:
-                return Response(err=e)
-
-        if r.method == "POST":
-            return f(lambda: self.store.create(r.path, r.dir, r.val, True,
-                                               expr))
-        if r.method == "PUT":
-            exists, exists_set = r.prev_exist, r.prev_exist is not None
-            if exists_set:
-                if exists:
-                    return f(lambda: self.store.update(r.path, r.val, expr))
-                return f(lambda: self.store.create(r.path, r.dir, r.val,
-                                                   False, expr))
-            if r.prev_index > 0 or r.prev_value != "":
-                return f(lambda: self.store.compare_and_swap(
-                    r.path, r.prev_value, r.prev_index, r.val, expr))
-            return f(lambda: self.store.set(r.path, r.dir, r.val, expr))
-        if r.method == "DELETE":
-            if r.prev_index > 0 or r.prev_value != "":
-                return f(lambda: self.store.compare_and_delete(
-                    r.path, r.prev_value, r.prev_index))
-            return f(lambda: self.store.delete(r.path, r.dir, r.recursive))
-        if r.method == "QGET":
-            return f(lambda: self.store.get(r.path, r.recursive, r.sorted))
-        if r.method == "SYNC":
-            self.store.delete_expired_keys(r.time / 1e9)
-            return Response()
-        return Response(err=UnknownMethodError(r.method))
+        return apply_request_to_store(self.store, r)
 
     def apply_conf_change(self, cc: ConfChange) -> None:
         """Reference server.go:542-559."""

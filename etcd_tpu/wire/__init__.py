@@ -12,6 +12,7 @@ from .proto import (
     Message,
     HardState,
     ConfChange,
+    GroupEntry,
     Record,
     SnapPb,
     ENTRY_NORMAL,
@@ -38,6 +39,7 @@ __all__ = [
     "Message",
     "HardState",
     "ConfChange",
+    "GroupEntry",
     "Record",
     "SnapPb",
     "ENTRY_NORMAL",

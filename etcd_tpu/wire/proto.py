@@ -444,6 +444,62 @@ class Record:
 
 
 @dataclass(slots=True)
+class GroupEntry:
+    """Multi-group WAL envelope (new work — no reference counterpart:
+    the reference runs ONE raft group per process, so its WAL needs no
+    group axis; the co-hosted server multiplexes G groups into one
+    record stream, keeping file count O(1) and the whole log
+    replayable as a single device batch).
+
+    ``kind``: 0 = a group's log entry (payload = marshaled Request),
+    1 = commit-frontier marker (payload = the [G] i32-LE commit vector
+    followed by the [G] i32-LE term-at-commit vector).
+    """
+
+    kind: int = 0
+    group: int = 0
+    gindex: int = 0
+    gterm: int = 0
+    payload: bytes | None = None
+
+    def marshal(self) -> bytes:
+        buf = bytearray()
+        _tagged_varint(buf, 0x08, self.kind)
+        _tagged_varint(buf, 0x10, self.group)
+        _tagged_varint(buf, 0x18, self.gindex)
+        _tagged_varint(buf, 0x20, self.gterm)
+        if self.payload is not None:
+            _tagged_bytes(buf, 0x2A, self.payload)
+        return bytes(buf)
+
+    @classmethod
+    def unmarshal(cls, data: bytes) -> "GroupEntry":
+        ge = cls()
+        pos = 0
+        while pos < len(data):
+            tag, pos = uvarint(data, pos)
+            fnum, wt = tag >> 3, tag & 7
+            if fnum == 1:
+                _expect_wt(fnum, wt, 0)
+                ge.kind, pos = uvarint(data, pos)
+            elif fnum == 2:
+                _expect_wt(fnum, wt, 0)
+                ge.group, pos = uvarint(data, pos)
+            elif fnum == 3:
+                _expect_wt(fnum, wt, 0)
+                ge.gindex, pos = uvarint(data, pos)
+            elif fnum == 4:
+                _expect_wt(fnum, wt, 0)
+                ge.gterm, pos = uvarint(data, pos)
+            elif fnum == 5:
+                _expect_wt(fnum, wt, 2)
+                ge.payload, pos = _bytes_field(data, pos)
+            else:
+                pos = _skip_field(data, pos, wt)
+        return ge
+
+
+@dataclass(slots=True)
 class SnapPb:
     """Snapshot file wrapper (reference snap/snappb/snap.proto).
 

@@ -1,0 +1,208 @@
+"""Co-hosted multi-group server: end-to-end serving seams.
+
+The reference's in-process cluster tests (server_test.go:370-447)
+generalized to G groups behind one server: client requests route to
+their namespace's group, batched consensus commits them, the WAL
+persists them, restart replays them, HTTP serves them.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from etcd_tpu.server.multigroup import MultiGroupServer, group_of
+from etcd_tpu.wire.requests import Request
+
+G, M, CAP = 8, 3, 64
+
+
+def _mk(tmp_path, **kw):
+    kw.setdefault("g", G)
+    kw.setdefault("m", M)
+    kw.setdefault("cap", CAP)
+    kw.setdefault("tick_interval", 0.02)
+    return MultiGroupServer(str(tmp_path / "data"), **kw)
+
+
+def _put(s, path, val, timeout=90):
+    return s.do(Request(id=np.random.randint(1, 2**62), method="PUT",
+                        path=path, val=val), timeout=timeout)
+
+
+def _get(s, path):
+    return s.do(Request(id=np.random.randint(1, 2**62), method="GET",
+                        path=path))
+
+
+def test_group_routing_spreads():
+    seen = {group_of(f"/ns{i}/k", G) for i in range(64)}
+    assert len(seen) > 2  # sha1 spread over groups
+    # deterministic
+    assert group_of("/apps/web", G) == group_of("/apps/other", G)
+
+
+def test_put_get_across_groups(tmp_path):
+    s = _mk(tmp_path)
+    s.start()
+    try:
+        for i in range(12):
+            resp = _put(s, f"/svc{i}/endpoint", f"10.0.0.{i}:4001")
+            assert resp.err is None
+            assert resp.event.action == "set"
+        for i in range(12):
+            ev = _get(s, f"/svc{i}/endpoint").event
+            assert ev.node.value == f"10.0.0.{i}:4001"
+        assert s.index() >= 12
+    finally:
+        s.stop()
+
+
+def test_cas_and_delete_through_consensus(tmp_path):
+    s = _mk(tmp_path)
+    s.start()
+    try:
+        _put(s, "/cfg/flag", "on")
+        resp = s.do(Request(id=7001, method="PUT", path="/cfg/flag",
+                            val="off", prev_value="on"), timeout=90)
+        assert resp.event.action == "compareAndSwap"
+        from etcd_tpu.utils.errors import EtcdError
+        with pytest.raises(EtcdError):
+            s.do(Request(id=7002, method="PUT", path="/cfg/flag",
+                         val="x", prev_value="WRONG"), timeout=90)
+        resp = s.do(Request(id=7003, method="DELETE",
+                            path="/cfg/flag"), timeout=90)
+        assert resp.event.action == "delete"
+    finally:
+        s.stop()
+
+
+def test_watch_fires_on_commit(tmp_path):
+    s = _mk(tmp_path)
+    s.start()
+    try:
+        wc = s.do(Request(id=7101, method="GET", path="/jobs/j1",
+                          wait=True)).watcher
+        got = []
+        t = threading.Thread(
+            target=lambda: got.append(wc.next_event(timeout=90)))
+        t.start()
+        _put(s, "/jobs/j1", "queued")
+        t.join(timeout=90)
+        assert got and got[0].action == "set"
+        assert got[0].node.value == "queued"
+    finally:
+        s.stop()
+
+
+def test_restart_replays_all_groups(tmp_path):
+    s = _mk(tmp_path)
+    s.start()
+    try:
+        for i in range(10):
+            _put(s, f"/db{i}/row", f"v{i}")
+    finally:
+        s.stop()
+    # a new server over the same data dir replays the committed state
+    s2 = _mk(tmp_path)
+    assert s2.index() >= 10
+    try:
+        for i in range(10):
+            ev = s2.store.get(f"/db{i}/row", False, False)
+            assert ev.node.value == f"v{i}"
+        # and keeps serving writes after replay
+        s2.start()
+        _put(s2, "/db0/row", "v0b")
+        ev = _get(s2, "/db0/row").event
+        assert ev.node.value == "v0b"
+    finally:
+        s2.stop()
+
+
+def test_snapshot_then_restart(tmp_path):
+    s = _mk(tmp_path, snap_count=5)
+    s.start()
+    try:
+        for i in range(12):
+            _put(s, f"/snapns{i % 3}/k{i}", f"x{i}")
+    finally:
+        s.stop()
+    import os
+    assert os.listdir(tmp_path / "data" / "snap")  # snapshot fired
+    s2 = _mk(tmp_path, snap_count=5)
+    try:
+        for i in range(12):
+            ev = s2.store.get(f"/snapns{i % 3}/k{i}", False, False)
+            assert ev.node.value == f"x{i}"
+    finally:
+        s2.stop()
+
+
+def test_double_restart_preserves_sequence(tmp_path):
+    """A restart (even with an empty post-snapshot WAL tail) must not
+    reset the global sequence: records written after the first
+    restart must stay contiguous for the SECOND restart's replay."""
+    s = _mk(tmp_path, snap_count=3)
+    s.start()
+    try:
+        for i in range(8):
+            _put(s, f"/et{i}/k", f"v{i}")
+    finally:
+        s.stop()
+    s2 = _mk(tmp_path, snap_count=3)   # restart 1: no writes at all
+    seq_after_replay = s2.seq
+    s2.stop()
+    assert seq_after_replay > 0
+    s3 = _mk(tmp_path, snap_count=3)   # restart 2: write, then again
+    assert s3.seq >= seq_after_replay
+    s3.start()
+    try:
+        _put(s3, "/et0/k", "v0b")
+    finally:
+        s3.stop()
+    s4 = _mk(tmp_path, snap_count=3)   # restart 3 replays cleanly
+    try:
+        assert s4.store.get("/et0/k", False, False).node.value == "v0b"
+        assert s4.store.get("/et7/k", False, False).node.value == "v7"
+        assert s4.index() >= 9
+    finally:
+        s4.stop()
+
+
+def test_http_puts_across_cohosted_groups(tmp_path):
+    """The VERDICT end-to-end gate: HTTP PUTs against many co-hosted
+    groups, batched consensus commits them, restart replays them."""
+    from etcd_tpu.api.http import make_client_handler, serve
+
+    s = _mk(tmp_path)
+    s.start()
+    httpd = None
+    try:
+        handler = make_client_handler(s)
+        httpd = serve(handler, "127.0.0.1", 0)
+        port = httpd.server_address[1]
+        for i in range(6):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v2/keys/web{i}/cfg",
+                data=f"value=V{i}".encode(), method="PUT")
+            req.add_header("Content-Type",
+                           "application/x-www-form-urlencoded")
+            with urllib.request.urlopen(req, timeout=90) as resp:
+                body = json.loads(resp.read())
+                assert body["action"] == "set"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v2/keys/web3/cfg",
+                timeout=30) as resp:
+            assert json.loads(resp.read())["node"]["value"] == "V3"
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+        s.stop()
+    s2 = _mk(tmp_path)
+    try:
+        ev = s2.store.get("/web5/cfg", False, False)
+        assert ev.node.value == "V5"
+    finally:
+        s2.stop()

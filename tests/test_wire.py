@@ -144,3 +144,20 @@ def test_wrong_wiretype_on_known_field_raises():
         Entry.unmarshal(bytes([0x08, 0, 0x10, 0, 0x18, 0, 0x20, 1]))
     with pytest.raises(ProtoError):  # Record.type as length-delimited
         Record.unmarshal(bytes([0x0A, 1, 0x61]))
+
+
+def test_group_entry_roundtrip():
+    """Multi-group WAL envelope (new work: multiplexes G co-hosted
+    groups into one WAL stream, server/multigroup.py)."""
+    from etcd_tpu.wire import GroupEntry
+    ge = GroupEntry(kind=0, group=1234, gindex=99, gterm=7,
+                    payload=b"\x01\x02payload")
+    got = GroupEntry.unmarshal(ge.marshal())
+    assert (got.kind, got.group, got.gindex, got.gterm, got.payload) \
+        == (0, 1234, 99, 7, b"\x01\x02payload")
+    marker = GroupEntry(kind=1, payload=b"\x00" * 16)
+    got = GroupEntry.unmarshal(marker.marshal())
+    assert got.kind == 1 and len(got.payload) == 16
+    # None payload omits the field entirely (gogoproto nil semantics)
+    empty = GroupEntry.unmarshal(GroupEntry(kind=1).marshal())
+    assert empty.payload is None
