@@ -1,4 +1,13 @@
-"""North-star benchmark: multi-group WAL replay with CRC parity.
+"""North-star benchmarks (BASELINE configs 1-4).
+
+Config 1 (the primary JSON metric): multi-group WAL replay with CRC
+parity.  Configs 2-4 run after it and land in the JSON line's extra
+fields + stderr:
+
+  config 2 — in-process 3-member cluster commit throughput
+             (TestClusterOf3's shape, batched over groups)
+  config 3 — large snapshot save/load with device hashing
+  config 4 — p50 commit-round latency at 10k groups x 5 members
 
 Scenario (BASELINE configs 1 & 4's shape): G co-hosted raft groups
 each replay an N/G-entry WAL segment (256 B payloads).  The reference
@@ -41,6 +50,11 @@ N_GROUPS = int(os.environ.get("BENCH_GROUPS", 64))
 PAYLOAD = int(os.environ.get("BENCH_PAYLOAD", 256))
 THREADS = int(os.environ.get("BENCH_THREADS",
                              min(16, os.cpu_count() or 1)))
+# configs 2-4 knobs (0 disables a config)
+C2_PROPOSALS = int(os.environ.get("BENCH_C2_PROPOSALS", 100_000))
+C3_SNAP_MB = int(os.environ.get("BENCH_C3_SNAP_MB", 256))
+C4_GROUPS = int(os.environ.get("BENCH_C4_GROUPS", 10_000))
+C4_ROUNDS = int(os.environ.get("BENCH_C4_ROUNDS", 30))
 # Accelerator init can be slow behind a device tunnel; probe generously
 # but never hang the bench (round-1 failure mode: backend init hung).
 BACKEND_TIMEOUT = int(os.environ.get("BENCH_BACKEND_TIMEOUT", 240))
@@ -146,6 +160,125 @@ def select_backend():
     return jax
 
 
+def bench_cluster_commits(total: int) -> float | None:
+    """Config 2: 3-member in-process cluster applying ``total``
+    proposals (the reference fixture's shape, server_test.go:370-447,
+    batched: G co-hosted 3-member clusters drain the load together).
+    Returns committed proposals/sec through full consensus rounds."""
+    import numpy as np
+
+    from etcd_tpu.raft.multiraft import MultiRaft
+
+    g = min(2048, max(64, total // 64))
+    mr = MultiRaft(g=g, m=3, cap=128)
+    mr.campaign(0)
+    per_round = np.full(g, 4, np.int32)
+    rounds = max(1, total // (g * 4))
+    mr.propose(per_round)  # warmup/compile
+    mr.mark_applied(mr.commit_index())
+    mr.compact()
+    t0 = time.perf_counter()
+    done = 0
+    for i in range(rounds):
+        done += int(mr.propose(per_round).sum())
+        if (i + 1) % 8 == 0:
+            mr.mark_applied(mr.commit_index())
+            mr.compact()
+    dt = time.perf_counter() - t0
+    log(f"config2: {done} proposals through {g} x 3-member clusters "
+        f"in {dt:.2f}s = {done / dt / 1e3:.1f}k/s")
+    return done / dt
+
+
+def bench_snapshot(mb: int) -> dict | None:
+    """Config 3: snapshot save/load with hash verify
+    (snap/snapshotter.go:39-74; device hash via ops/crc_kernel)."""
+    import tempfile
+
+    from etcd_tpu.snap import Snapshotter
+    from etcd_tpu.wire import Snapshot
+
+    rng = np.random.default_rng(7)
+    blob = rng.integers(0, 256, size=mb << 20, dtype=np.uint8).tobytes()
+    out = {}
+    for mode in ("tpu", "host"):
+        crc_fn = None
+        if mode == "tpu":
+            from etcd_tpu.ops.crc_kernel import auto_crc32c
+
+            crc_fn = auto_crc32c
+            auto_crc32c(blob[: 8 << 20])  # compile warmup
+        with tempfile.TemporaryDirectory() as d:
+            ss = Snapshotter(d, crc_fn=crc_fn)
+            t0 = time.perf_counter()
+            ss.save_snap(Snapshot(data=blob, index=1, term=1))
+            t_save = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            got = ss.load()
+            t_load = time.perf_counter() - t0
+            assert got.data == blob
+        out[mode] = (mb / t_save, mb / t_load)
+        log(f"config3[{mode}]: save {mb}MB @ {mb / t_save:.0f} MB/s, "
+            f"load @ {mb / t_load:.0f} MB/s")
+    return out
+
+
+def bench_group_latency(g: int, rounds: int) -> dict | None:
+    """Config 4: p50/p99 commit-round latency at g groups x 5 members
+    (the batched maybeCommit+append being scaled, raft.go:248-258)."""
+    import numpy as np
+
+    from etcd_tpu.raft.multiraft import MultiRaft
+
+    mr = MultiRaft(g=g, m=5, cap=64)
+    mr.campaign(0)
+    one = np.ones(g, np.int32)
+    mr.propose(one)  # warmup/compile
+    lats = []
+    for i in range(rounds):
+        t0 = time.perf_counter()
+        newly = mr.propose(one)
+        lats.append(time.perf_counter() - t0)
+        assert int(newly.sum()) == g
+        if (i + 1) % 16 == 0:
+            mr.mark_applied(mr.commit_index())
+            mr.compact()
+    lats_ms = np.sort(np.asarray(lats)) * 1e3
+    p50 = float(np.percentile(lats_ms, 50))
+    p99 = float(np.percentile(lats_ms, 99))
+    eps = g / (p50 / 1e3)
+    log(f"config4: {g} groups x 5 members, {rounds} rounds: "
+        f"p50 {p50:.1f}ms p99 {p99:.1f}ms "
+        f"({eps / 1e6:.2f}M group-commits/s at p50)")
+    return {"p50_ms": round(p50, 2), "p99_ms": round(p99, 2),
+            "group_commits_per_sec": round(eps, 0)}
+
+
+def run_extra_configs(extra: dict) -> None:
+    """Configs 2-4; failures degrade to logged errors, never kill the
+    primary metric emission."""
+    if C2_PROPOSALS:
+        try:
+            r = bench_cluster_commits(C2_PROPOSALS)
+            extra["config2_proposals_per_sec"] = round(r, 0)
+        except Exception as e:
+            log(f"config2 failed: {e!r}")
+    if C3_SNAP_MB:
+        try:
+            r = bench_snapshot(C3_SNAP_MB)
+            extra["config3_snapshot_save_mbps"] = {
+                k: round(v[0], 0) for k, v in r.items()}
+            extra["config3_snapshot_load_mbps"] = {
+                k: round(v[1], 0) for k, v in r.items()}
+        except Exception as e:
+            log(f"config3 failed: {e!r}")
+    if C4_GROUPS:
+        try:
+            extra["config4"] = bench_group_latency(C4_GROUPS, C4_ROUNDS)
+        except Exception as e:
+            log(f"config4 failed: {e!r}")
+
+
 def main():
     from etcd_tpu import native
 
@@ -231,6 +364,7 @@ def main():
         # An honest chip metric requires a chip; a cpu-fallback number
         # is still emitted (value > 0) but unmistakably marked.
         extra["degraded"] = True
+    run_extra_configs(extra)
     emit(dev_eps, dev_eps / base_eps, **extra)
 
 

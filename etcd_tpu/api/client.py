@@ -21,11 +21,18 @@ class Client:
     """Minimal v2 client (the reference's is just what discovery
     needs; ours adds delete/set for the CLI and tests)."""
 
-    def __init__(self, endpoints: list[str], timeout: float = 5.0):
+    def __init__(self, endpoints: list[str], timeout: float = 5.0,
+                 tls_info=None):
+        """``tls_info`` (utils.transport.TLSInfo): client context for
+        https endpoints — client-cert auth + CA verification
+        (reference pkg/transport/listener.go:114-135)."""
         if not endpoints:
             raise ValueError("no endpoints")
         self.endpoints = [e.rstrip("/") for e in endpoints]
         self.timeout = timeout
+        self._ssl = None
+        if tls_info is not None and not tls_info.empty():
+            self._ssl = tls_info.client_context()
 
     # -- http --------------------------------------------------------------
 
@@ -43,7 +50,8 @@ class Client:
                                "application/x-www-form-urlencoded")
             try:
                 with urllib.request.urlopen(
-                        req, timeout=timeout or self.timeout) as resp:
+                        req, timeout=timeout or self.timeout,
+                        context=self._ssl) as resp:
                     body = resp.read().decode()
                     out = json.loads(body) if body.strip() else {}
                     out["etcdIndex"] = int(

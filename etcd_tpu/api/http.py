@@ -335,6 +335,13 @@ class EtcdRequestHandler(BaseHTTPRequestHandler):
             body = self.etcd.server_stats.to_json()
         elif sub == "leader":
             body = self.etcd.leader_stats.to_json()
+        elif sub == "spans":
+            # host-span latency aggregates (SURVEY §5.1 new work; no
+            # reference counterpart — 0.5-alpha has no stats route at
+            # all, let alone tracing)
+            from ..utils.trace import tracer
+
+            body = tracer.snapshot_json()
         else:
             self._reply(404, b"404 page not found\n")
             return

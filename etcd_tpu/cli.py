@@ -177,13 +177,15 @@ def start_multigroup(args, explicit: set[str]) -> int:
 
     data_dir = args.data_dir or f"{args.name}_multigroup_data"
     os.makedirs(data_dir, mode=0o700, exist_ok=True)
+    client_tls = TLSInfo(args.cert_file, args.key_file, args.ca_file)
+    acurls = urls_from_flags(args, "advertise_client_urls", "addr",
+                             explicit, client_tls.empty())
     s = MultiGroupServer(
         data_dir, g=args.cohosted_groups, m=args.cohosted_members,
         name=args.name, snap_count=args.snapshot_count,
-        storage_backend=args.storage_backend)
+        storage_backend=args.storage_backend,
+        client_urls=list(acurls))
     s.start()
-
-    client_tls = TLSInfo(args.cert_file, args.key_file, args.ca_file)
     cors = parse_cors(args.cors) if args.cors else None
     ch = make_client_handler(s, cors=cors)
     lcurls = urls_from_flags(args, "listen_client_urls", "bind_addr",
@@ -228,6 +230,7 @@ def start_etcd(args, cluster: Cluster, explicit: set[str]) -> int:
         discovery_url=args.discovery,
         cluster_state=args.initial_cluster_state,
         storage_backend=args.storage_backend,
+        peer_tls=peer_tls if not peer_tls.empty() else None,
     )
     s = new_server(cfg)
     s.start()

@@ -24,6 +24,8 @@ class ServerConfig:
     # "tpu" = batched device replay (wal/replay_device.py), "auto" =
     # device for large logs, host for small ones (compile latency).
     storage_backend: str = "auto"
+    # peer transport TLS (utils.transport.TLSInfo); None/empty = http
+    peer_tls: object = None
 
     def verify(self) -> None:
         """Reference config.go:24-43."""

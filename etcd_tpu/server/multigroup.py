@@ -47,6 +47,7 @@ import numpy as np
 
 from ..snap import NoSnapshotError, Snapshotter
 from ..store import Store
+from ..utils.trace import maybe_start_jax_profile, tracer
 from ..utils.wait import Wait
 from ..wal import WAL, exist as wal_exist
 from ..wire import Entry, GroupEntry, HardState, Snapshot
@@ -91,7 +92,9 @@ class MultiGroupServer:
                  snap_count: int = DEFAULT_SNAP_COUNT,
                  storage_backend: str = "auto",
                  max_batch_ents: int = 32,
-                 tick_interval: float = TICK_INTERVAL):
+                 tick_interval: float = TICK_INTERVAL,
+                 sync_interval: float = 0.5,
+                 client_urls: list[str] | None = None):
         from ..raft.multiraft import MultiRaft
 
         self.g, self.m = g, m
@@ -99,6 +102,8 @@ class MultiGroupServer:
         self.snap_count = snap_count or DEFAULT_SNAP_COUNT
         self.backend = storage_backend
         self.tick_interval = tick_interval
+        self.sync_interval = sync_interval
+        self._campaign_slot = 0
         self.id = int.from_bytes(
             hashlib.sha1(name.encode()).digest()[:8], "big") & (2**63 - 1)
 
@@ -112,6 +117,7 @@ class MultiGroupServer:
         self.server_stats = ServerStats(name, self.id)
         self.leader_stats = LeaderStats(self.id)
         self.cluster_store = ClusterStore(self.store)
+        self._client_urls = client_urls or []
 
         os.makedirs(data_dir, mode=0o700, exist_ok=True)
         self._snapdir = os.path.join(data_dir, "snap")
@@ -173,6 +179,10 @@ class MultiGroupServer:
         applied_total = 0
         if snap is not None:
             blob = json.loads(snap.data.decode())
+            if len(blob["frontier"]) != g:
+                raise RuntimeError(
+                    f"snapshot was written with --cohosted-groups "
+                    f"{len(blob['frontier'])}, not {g}")
             self.store.recovery(blob["store"].encode())
             frontier = np.asarray(blob["frontier"], np.int64)
             terms = np.asarray(blob["terms"], np.int64)
@@ -202,6 +212,11 @@ class MultiGroupServer:
                 winners[(ge.group, ge.gindex)] = k
             elif ge.kind == 1:
                 v = np.frombuffer(ge.payload, np.int32)
+                if v.size != 2 * g:
+                    raise RuntimeError(
+                        f"data dir was written with "
+                        f"--cohosted-groups {v.size // 2}, not {g}; "
+                        f"group routing would silently change")
                 frontier = v[:g].astype(np.int64)
                 terms = v[g:2 * g].astype(np.int64)
             self.seq = max(self.seq, e.index)
@@ -245,14 +260,58 @@ class MultiGroupServer:
     # -- lifecycle --------------------------------------------------------
 
     def start(self) -> None:
+        maybe_start_jax_profile()
+        self._register_self()
         # bootstrap election + one replication round BEFORE serving:
         # the first fused-round jit compile (seconds) must not eat
         # into early clients' 500ms request timeouts
         if (self.mr.leader < 0).any():
-            self.mr.campaign(0, mask=self.mr.leader < 0)
-        self._absorb_commits({})
+            with tracer.span("mg.bootstrap_election"):
+                self._campaign_and_fence(self.mr.leader < 0)
+        else:
+            self._absorb_commits({})
         self._thread = threading.Thread(target=self.run, daemon=True)
         self._thread.start()
+
+    def _register_self(self) -> None:
+        """Register this server under /_etcd/machines so
+        /v2/machines serves real endpoints (member.go:15,57's
+        replicated-registry pattern; idempotent across restarts)."""
+        from .cluster import Member
+
+        try:
+            self.cluster_store.add(Member(
+                id=self.id, name=self.name,
+                peer_urls=self._client_urls,
+                client_urls=self._client_urls))
+        except Exception:
+            pass  # already registered (e.g. restored from snapshot)
+
+    def _campaign_and_fence(self, mask) -> None:
+        """Elect leaders for the masked groups, then persist fence
+        records for the becoming-leader empty entries: they consume a
+        gindex without a client payload, and an older never-acked
+        record at that index must not win the next restart's replay
+        (last-record-wins would resurrect dropped data)."""
+        mr = self.mr
+        slot = self._campaign_slot
+        self._campaign_slot = (slot + 1) % self.m
+        won = mr.campaign(slot, mask=np.asarray(mask, bool))
+        fences: list[Entry] = []
+        if won.any():
+            base = mr.last_base
+            valid = mr.last_valid
+            terms_now = np.max(np.stack(
+                [np.asarray(st.term) for st in mr.states]), axis=0)
+            for gi in np.nonzero(won & valid)[0]:
+                self.seq += 1
+                fences.append(Entry(
+                    index=self.seq, term=int(terms_now[gi]),
+                    data=GroupEntry(
+                        kind=0, group=int(gi),
+                        gindex=int(base[gi]) + 1,
+                        gterm=int(terms_now[gi])).marshal()))
+        self._absorb_commits({}, fences)
 
     def stop(self) -> None:
         self.done.set()
@@ -314,6 +373,8 @@ class MultiGroupServer:
         consensus round for all groups, persist, apply, ack."""
         mr = self.mr
         next_tick = time.monotonic() + self.tick_interval
+        next_sync = time.monotonic() + self.sync_interval
+        batch: list[_Pending] = []
 
         while not self.done.is_set():
             batch = self._drain(timeout=min(
@@ -324,8 +385,15 @@ class MultiGroupServer:
             now = time.monotonic()
             if now >= next_tick:
                 if (mr.leader < 0).any():
-                    mr.tick()
+                    self._campaign_and_fence(mr.leader < 0)
                 next_tick = now + self.tick_interval
+            if now >= next_sync:
+                # TTL expiry: co-hosted members share ONE store, so
+                # the reference's proposal-carried SYNC determinism
+                # (server.go:438-456) is vacuous here — expire
+                # directly on the shared tree
+                self.store.delete_expired_keys(time.time())
+                next_sync = now + self.sync_interval
 
             n_new = np.zeros(self.g, np.int32)
             data: list[list[bytes]] = [[] for _ in range(self.g)]
@@ -352,11 +420,13 @@ class MultiGroupServer:
                 self._absorb_commits({})
                 continue
 
-            mr.propose(n_new, data=data)
+            with tracer.span("mg.consensus_round"):
+                mr.propose(n_new, data=data)
             valid = mr.last_valid
             base = mr.last_base
             terms_now = np.max(np.stack(
-                [np.asarray(st.term) for st in mr.states]), axis=0)
+                [np.asarray(st.term) for st in mr.states]),
+                axis=0).astype(np.int32)
             assigned: dict[tuple[int, int], _Pending] = {}
             to_persist: list[Entry] = []
             for gi in range(self.g):
@@ -383,14 +453,24 @@ class MultiGroupServer:
                             gterm=int(terms_now[gi]),
                             payload=p.data).marshal()))
 
-            self._absorb_commits(assigned, to_persist)
+            self._absorb_commits(assigned, to_persist, terms_now)
             if mr.errors["overflow"].any():
                 # compaction AFTER absorb: mark_applied(self.applied)
                 # inside _absorb_commits bounds it, so committed-but-
                 # unapplied payloads are never pruned
                 mr.compact()
 
-        # server stopping: release every waiter
+        # server stopping: promptly release EVERY waiter — the final
+        # drained batch, anything still queued, and the requeues
+        for p in batch:
+            self.w.trigger(p.id, None)
+        while True:
+            try:
+                p = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if p is not None:
+                self.w.trigger(p.id, None)
         for q in self._requeue:
             while q:
                 self.w.trigger(q.popleft().id, None)
@@ -413,7 +493,8 @@ class MultiGroupServer:
             if p is not None:
                 out.append(p)
 
-    def _absorb_commits(self, assigned, to_persist=None) -> None:
+    def _absorb_commits(self, assigned, to_persist=None,
+                        terms_now=None) -> None:
         """Persist-then-apply: newly appended entries and the commit
         frontier go to the WAL (fsync) BEFORE any client ack — the
         Ready contract's ordering (node.go:41-60) at batch level."""
@@ -423,9 +504,11 @@ class MultiGroupServer:
         if to_persist or newly.any():
             terms = np.zeros(self.g, np.int32)
             if newly.any():
-                lead_terms = np.max(np.stack(
-                    [np.asarray(st.term) for st in mr.states]), axis=0)
-                terms = lead_terms.astype(np.int32)
+                if terms_now is None:
+                    terms_now = np.max(np.stack(
+                        [np.asarray(st.term) for st in mr.states]),
+                        axis=0).astype(np.int32)
+                terms = terms_now
                 self.raft_term = max(self.raft_term,
                                      int(terms.max()))
             frontier = GroupEntry(
@@ -435,11 +518,21 @@ class MultiGroupServer:
             ents = (to_persist or []) + [
                 Entry(index=self.seq, term=self.raft_term,
                       data=frontier)]
-            self.wal.save(HardState(term=self.raft_term, vote=0,
-                                    commit=self.seq), ents)
+            with tracer.span("mg.persist"):
+                self.wal.save(HardState(term=self.raft_term, vote=0,
+                                        commit=self.seq), ents)
 
         if not newly.any():
             return
+        with tracer.span("mg.apply"):
+            self._apply_newly(assigned, commit, newly)
+        mr.mark_applied(self.applied)
+
+        if self.raft_index - self._snapi > self.snap_count:
+            self.snapshot()
+
+    def _apply_newly(self, assigned, commit, newly) -> None:
+        mr = self.mr
         for gi in np.nonzero(newly)[0]:
             for idx in range(int(self.applied[gi]) + 1,
                              int(commit[gi]) + 1):
@@ -458,10 +551,6 @@ class MultiGroupServer:
                     if payload:
                         self.w.trigger(r.id, resp)
             self.applied[gi] = commit[gi]
-        mr.mark_applied(self.applied)
-
-        if self.raft_index - self._snapi > self.snap_count:
-            self.snapshot()
 
     # -- snapshot / compaction --------------------------------------------
 
@@ -478,10 +567,11 @@ class MultiGroupServer:
             "seq": self.seq,
             "applied_total": self.raft_index,
         }).encode()
-        self.ss.save_snap(Snapshot(data=blob, index=self.seq,
-                                   term=self.raft_term))
-        mr.compact()
-        self.wal.cut()
+        with tracer.span("mg.snapshot"):
+            self.ss.save_snap(Snapshot(data=blob, index=self.seq,
+                                       term=self.raft_term))
+            mr.compact()
+            self.wal.cut()
         self._snapi = self.raft_index
         log.info("multigroup: snapshot at seq=%d (applied=%d)",
                  self.seq, self.raft_index)

@@ -26,12 +26,14 @@ from .cluster import RAFT_PREFIX, ClusterStore
 log = logging.getLogger(__name__)
 
 
-def default_post(url: str, data: bytes, timeout: float = 1.0) -> bool:
+def default_post(url: str, data: bytes, timeout: float = 1.0,
+                 ssl_context=None) -> bool:
     req = urllib.request.Request(
         url, data=data, method="POST",
         headers={"Content-Type": "application/protobuf"})
     try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
+        with urllib.request.urlopen(req, timeout=timeout,
+                                    context=ssl_context) as resp:
             return resp.status == 204
     except (urllib.error.URLError, OSError):
         return False
@@ -39,13 +41,24 @@ def default_post(url: str, data: bytes, timeout: float = 1.0) -> bool:
 
 def new_sender(cluster_store: ClusterStore,
                post_fn: Callable[[str, bytes], bool] | None = None,
-               leader_stats=None):
+               leader_stats=None, tls_info=None):
     """Returns send(msgs) that MUST NOT block (server.go:202-206).
 
     ``leader_stats`` (server/stats.py LeaderStats) records per-follower
     append round-trip latency and failures when provided.
+    ``tls_info`` (utils.transport.TLSInfo): when set and non-empty,
+    peer POSTs use its client context — cert/key for client-cert auth
+    and CA verification against https peers (the reference hands its
+    Sender a TLS-capable transport, pkg/transport/listener.go:32-50).
     """
-    post = post_fn or default_post
+    post = post_fn
+    if post is None:
+        ctx = None
+        if tls_info is not None and not tls_info.empty():
+            ctx = tls_info.client_context()
+
+        def post(url, data, _ctx=ctx):
+            return default_post(url, data, ssl_context=_ctx)
 
     def send(msgs: list[Message]) -> None:
         for m in msgs:

@@ -24,6 +24,7 @@ from ..raft import Node, Peer, STATE_LEADER, restart_node, start_node
 from ..snap import NoSnapshotError, Snapshotter
 from ..store import Store, Watcher
 from ..utils.errors import EtcdError
+from ..utils.trace import tracer
 from ..utils.wait import Wait
 from ..wal import WAL, exist as wal_exist
 from ..wire import (
@@ -228,26 +229,29 @@ class EtcdServer:
                 continue
 
             # persist BEFORE send (the Ready contract, node.go:41-60)
-            self.storage.save(rd.hard_state, rd.entries)
-            self.storage.save_snap(rd.snapshot)
+            with tracer.span("server.persist"):
+                self.storage.save(rd.hard_state, rd.entries)
+                self.storage.save_snap(rd.snapshot)
             for m in rd.messages:
                 if m.type == MSG_APP:
                     self.server_stats.send_append()
-            self.send(rd.messages)
+            with tracer.span("server.send"):
+                self.send(rd.messages)
 
-            for e in rd.committed_entries:
-                if e.type == ENTRY_NORMAL:
-                    r = Request.unmarshal(e.data)
-                    self.w.trigger(r.id, self.apply_request(r))
-                elif e.type == ENTRY_CONF_CHANGE:
-                    cc = ConfChange.unmarshal(e.data)
-                    self.apply_conf_change(cc)
-                    self.w.trigger(cc.id, None)
-                else:  # pragma: no cover
-                    raise AssertionError("unexpected entry type")
-                self.raft_index = e.index
-                self.raft_term = e.term
-                appliedi = e.index
+            with tracer.span("server.apply"):
+                for e in rd.committed_entries:
+                    if e.type == ENTRY_NORMAL:
+                        r = Request.unmarshal(e.data)
+                        self.w.trigger(r.id, self.apply_request(r))
+                    elif e.type == ENTRY_CONF_CHANGE:
+                        cc = ConfChange.unmarshal(e.data)
+                        self.apply_conf_change(cc)
+                        self.w.trigger(cc.id, None)
+                    else:  # pragma: no cover
+                        raise AssertionError("unexpected entry type")
+                    self.raft_index = e.index
+                    self.raft_term = e.term
+                    appliedi = e.index
 
             if rd.soft_state is not None:
                 nodes = rd.soft_state.nodes
@@ -410,9 +414,10 @@ class EtcdServer:
     def snapshot(self, snapi: int, snapnodes: list[int]) -> None:
         """Store snapshot -> raft compaction -> WAL cut
         (reference server.go:562-571)."""
-        d = self.store.save()
-        self.node.compact(snapi, snapnodes, d)
-        self.storage.cut()
+        with tracer.span("server.snapshot"):
+            d = self.store.save()
+            self.node.compact(snapi, snapnodes, d)
+            self.storage.cut()
 
 
 # In "auto" mode the batched device replay only pays off once the WAL
@@ -432,8 +437,9 @@ def _replay_wal(waldir: str, index: int, backend: str):
             try:
                 from ..wal.replay_device import open_replay_device
 
-                w, md, hard_state, block = open_replay_device(
-                    waldir, index)
+                with tracer.span("replay.device"):
+                    w, md, hard_state, block = open_replay_device(
+                        waldir, index)
                 log.info("etcdserver: device replay of %d entries "
                          "(%d bytes)", len(block), size)
                 return w, md, hard_state, block.entries()
@@ -442,8 +448,9 @@ def _replay_wal(waldir: str, index: int, backend: str):
                     raise
                 log.warning("etcdserver: device replay failed; "
                             "falling back to host path", exc_info=True)
-    w = WAL.open_at_index(waldir, index)
-    md, hard_state, ents = w.read_all()
+    with tracer.span("replay.host"):
+        w = WAL.open_at_index(waldir, index)
+        md, hard_state, ents = w.read_all()
     return w, md, hard_state, ents
 
 
@@ -519,7 +526,8 @@ def new_server(cfg: ServerConfig, *, discoverer=None,
         attributes={"Name": cfg.name,
                     "ClientURLs": cfg.client_urls},
         storage=WalSnapStorage(w, ss),
-        send=new_sender(cls, post_fn=post_fn, leader_stats=lstats),
+        send=new_sender(cls, post_fn=post_fn, leader_stats=lstats,
+                        tls_info=getattr(cfg, "peer_tls", None)),
         leader_stats=lstats,
         cluster_store=cls,
         snap_count=cfg.snap_count,

@@ -1,0 +1,126 @@
+"""First-class host tracing + optional JAX profiler capture.
+
+New work mandated by SURVEY §5.1: the reference has nothing beyond
+``log.Printf`` at state transitions (raft/node.go:208) and no pprof
+endpoint in this snapshot.  Here every hot seam (WAL persist, replay,
+consensus round, apply, snapshot) runs under a named span; aggregated
+latency stats (count/mean/p50/p99/max over a sliding window) are
+exported via ``/v2/stats/spans`` and a JAX device-profile capture can
+be armed with ``ETCD_TRACE_DIR=/path`` (written via
+``jax.profiler.start_trace`` for xprof/tensorboard).
+
+Design: recording is a lock + deque append (no allocation on the hot
+path beyond the float); percentile math runs only at snapshot time.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+
+log = logging.getLogger(__name__)
+
+_WINDOW = 256  # sliding window per span for percentile estimates
+
+
+class _Span:
+    __slots__ = ("tracer", "name", "t0")
+
+    def __init__(self, tracer: "Tracer", name: str):
+        self.tracer = tracer
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.tracer.record(self.name, time.perf_counter() - self.t0)
+        return False
+
+
+class Tracer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats: dict[str, list] = {}  # name -> [count, total, max, ring]
+
+    def span(self, name: str) -> _Span:
+        return _Span(self, name)
+
+    def record(self, name: str, dt: float) -> None:
+        with self._lock:
+            s = self._stats.get(name)
+            if s is None:
+                s = [0, 0.0, 0.0, deque(maxlen=_WINDOW)]
+                self._stats[name] = s
+            s[0] += 1
+            s[1] += dt
+            if dt > s[2]:
+                s[2] = dt
+            s[3].append(dt)
+
+    def snapshot(self) -> dict:
+        out = {}
+        with self._lock:
+            items = [(k, v[0], v[1], v[2], sorted(v[3]))
+                     for k, v in self._stats.items()]
+        for name, count, total, mx, ring in items:
+            if not ring:
+                continue
+            p50 = ring[len(ring) // 2]
+            p99 = ring[min(len(ring) - 1, int(len(ring) * 0.99))]
+            out[name] = {
+                "count": count,
+                "total_ms": round(total * 1e3, 3),
+                "mean_ms": round(total / count * 1e3, 3),
+                "p50_ms": round(p50 * 1e3, 3),
+                "p99_ms": round(p99 * 1e3, 3),
+                "max_ms": round(mx * 1e3, 3),
+            }
+        return out
+
+    def snapshot_json(self) -> bytes:
+        return (json.dumps(self.snapshot(), sort_keys=True) +
+                "\n").encode()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
+
+#: process-wide default tracer — servers and replay paths record here
+tracer = Tracer()
+
+_profiling = False
+
+
+def maybe_start_jax_profile() -> bool:
+    """Arm a device-level trace when ETCD_TRACE_DIR is set (xprof
+    format; inspect with tensorboard).  Idempotent; returns whether a
+    capture is running."""
+    global _profiling
+    d = os.environ.get("ETCD_TRACE_DIR")
+    if not d or _profiling:
+        return _profiling
+    try:
+        import jax
+
+        jax.profiler.start_trace(d)
+        _profiling = True
+        log.info("trace: JAX profiler capturing to %s", d)
+    except Exception as e:  # pragma: no cover - device/env specific
+        log.warning("trace: could not start JAX profiler: %s", e)
+    return _profiling
+
+
+def stop_jax_profile() -> None:
+    global _profiling
+    if _profiling:
+        import jax
+
+        jax.profiler.stop_trace()
+        _profiling = False

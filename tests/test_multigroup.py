@@ -140,6 +140,91 @@ def test_snapshot_then_restart(tmp_path):
         s2.stop()
 
 
+def test_ttl_expires_in_cohosted_mode(tmp_path):
+    """TTL keys must actually expire (the reference drives this via
+    leader SYNC proposals; co-hosted members share one store, so
+    expiry runs directly on the shared tree)."""
+    import time
+
+    s = _mk(tmp_path, sync_interval=0.05)
+    s.start()
+    try:
+        s.do(Request(id=8101, method="PUT", path="/lease/a", val="v",
+                     expiration=int((time.time() + 0.3) * 1e9)),
+             timeout=90)
+        assert _get(s, "/lease/a").event.node.value == "v"
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            time.sleep(0.1)
+            from etcd_tpu.utils.errors import EtcdError
+            try:
+                _get(s, "/lease/a")
+            except EtcdError:
+                break  # expired
+        else:
+            raise AssertionError("TTL key never expired")
+    finally:
+        s.stop()
+
+
+def test_stop_releases_waiters_promptly(tmp_path):
+    """In-flight proposals must fail fast with ServerStoppedError on
+    shutdown, not hang or wait out their timeout."""
+    import time
+
+    from etcd_tpu.server.server import ServerStoppedError
+
+    s = _mk(tmp_path)
+    s.start()
+    _put(s, "/warm/k", "v")  # ensure compile done
+    results = []
+
+    def client():
+        try:
+            _put(s, "/late/k", "v", timeout=60)
+            results.append("ok")
+        except ServerStoppedError:
+            results.append("stopped")
+        except TimeoutError:
+            results.append("timeout")
+
+    ts = [threading.Thread(target=client) for _ in range(4)]
+    t0 = time.time()
+    for t in ts:
+        t.start()
+    s.stop()
+    for t in ts:
+        t.join(timeout=30)
+    took = time.time() - t0
+    assert len(results) == 4
+    assert took < 20  # nobody waited out a 60s timeout
+    # every client got a definite outcome (committed before the stop
+    # landed, or a prompt stopped signal)
+    assert set(results) <= {"ok", "stopped"}
+
+
+def test_restart_wrong_group_count_rejected(tmp_path):
+    s = _mk(tmp_path)
+    s.start()
+    try:
+        _put(s, "/x/k", "v")
+    finally:
+        s.stop()
+    with pytest.raises(RuntimeError, match="cohosted-groups"):
+        MultiGroupServer(str(tmp_path / "data"), g=G * 2, m=M,
+                         cap=CAP)
+
+
+def test_machines_endpoint_lists_self(tmp_path):
+    s = _mk(tmp_path, client_urls=["http://127.0.0.1:9999"])
+    s.start()
+    try:
+        urls = s.cluster_store.get().client_urls_all()
+        assert "http://127.0.0.1:9999" in urls
+    finally:
+        s.stop()
+
+
 def test_double_restart_preserves_sequence(tmp_path):
     """A restart (even with an empty post-snapshot WAL tail) must not
     reset the global sequence: records written after the first

@@ -1,0 +1,75 @@
+"""Host-span tracing (SURVEY §5.1 new-work mandate)."""
+
+import json
+import time
+
+from etcd_tpu.utils.trace import Tracer, tracer
+
+
+def test_span_aggregates():
+    t = Tracer()
+    for i in range(10):
+        with t.span("work"):
+            time.sleep(0.001)
+    with t.span("other"):
+        pass
+    snap = t.snapshot()
+    assert snap["work"]["count"] == 10
+    assert snap["work"]["p50_ms"] >= 0.5
+    assert snap["work"]["max_ms"] >= snap["work"]["p50_ms"]
+    assert "other" in snap
+    t.reset()
+    assert t.snapshot() == {}
+
+
+def test_server_records_spans(tmp_path):
+    """The seams (persist/apply/replay) run under named spans."""
+    tracer.reset()
+    from etcd_tpu.server.multigroup import MultiGroupServer
+    from etcd_tpu.wire.requests import Request
+
+    s = MultiGroupServer(str(tmp_path / "d"), g=4, m=3, cap=32,
+                         tick_interval=0.02)
+    s.start()
+    try:
+        s.do(Request(id=42, method="PUT", path="/t/k", val="v"),
+             timeout=90)
+    finally:
+        s.stop()
+    snap = tracer.snapshot()
+    assert "mg.consensus_round" in snap
+    assert "mg.persist" in snap
+    assert "mg.apply" in snap
+    assert snap["mg.persist"]["count"] >= 1
+    # restart path records a replay span
+    tracer.reset()
+    s2 = MultiGroupServer(str(tmp_path / "d"), g=4, m=3, cap=32)
+    s2.stop()
+    assert any(k.startswith("replay.") for k in tracer.snapshot())
+
+
+def test_spans_http_endpoint(tmp_path):
+    import urllib.request
+
+    from etcd_tpu.api.http import make_client_handler, serve
+    from etcd_tpu.server.multigroup import MultiGroupServer
+    from etcd_tpu.wire.requests import Request
+
+    s = MultiGroupServer(str(tmp_path / "d"), g=4, m=3, cap=32,
+                         tick_interval=0.02)
+    s.start()
+    httpd = serve(make_client_handler(s), "127.0.0.1", 0)
+    try:
+        s.do(Request(id=43, method="PUT", path="/t/k2", val="v"),
+             timeout=90)
+        port = httpd.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v2/stats/spans",
+                timeout=30) as resp:
+            spans = json.loads(resp.read())
+        assert "mg.consensus_round" in spans
+        assert spans["mg.consensus_round"]["count"] >= 1
+        assert "p99_ms" in spans["mg.consensus_round"]
+    finally:
+        httpd.shutdown()
+        s.stop()
