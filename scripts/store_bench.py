@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Store microbenchmarks — the repo equivalent of the reference's
+store/store_bench_test.go:26-178 harness (Set @ 128/1024/4096 B,
+Delete, Watch variants with heap stats), so store-path regressions
+are visible.
+
+Run: ``python scripts/store_bench.py [--quick]``.
+Prints one table row per benchmark: ops/s and peak-RSS delta (the
+``runtime.ReadMemStats`` analog).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import resource
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from etcd_tpu.store import Store  # noqa: E402
+
+
+def _rss_kb() -> int:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+
+
+def _row(name: str, n: int, secs: float, rss0: int) -> None:
+    print(f"{name:<28} {n:>8} ops  {n / secs:>12.0f} ops/s  "
+          f"{secs / n * 1e6:>8.2f} us/op  "
+          f"rss +{max(0, _rss_kb() - rss0) // 1024} MB", flush=True)
+
+
+def bench_set(n: int, size: int) -> None:
+    s = Store()
+    val = "x" * size
+    rss0 = _rss_kb()
+    t0 = time.perf_counter()
+    for i in range(n):
+        s.set(f"/b/k{i}", False, val, None)
+    _row(f"set value={size}B", n, time.perf_counter() - t0, rss0)
+
+
+def bench_delete(n: int) -> None:
+    s = Store()
+    for i in range(n):
+        s.set(f"/b/k{i}", False, "v", None)
+    rss0 = _rss_kb()
+    t0 = time.perf_counter()
+    for i in range(n):
+        s.delete(f"/b/k{i}", False, False)
+    _row("delete", n, time.perf_counter() - t0, rss0)
+
+
+def bench_watch(n: int, watchers_per_key: int = 1) -> None:
+    s = Store()
+    rss0 = _rss_kb()
+    t0 = time.perf_counter()
+    ws = []
+    for i in range(n):
+        for _ in range(watchers_per_key):
+            ws.append(s.watch(f"/b/k{i}", False, False, 0))
+    t_reg = time.perf_counter() - t0
+    _row(f"watch register x{watchers_per_key}", n * watchers_per_key,
+         t_reg, rss0)
+    t0 = time.perf_counter()
+    for i in range(n):
+        s.set(f"/b/k{i}", False, "v", None)
+    for w in ws:
+        assert w.next_event(timeout=5) is not None
+    _row(f"watch fire+drain x{watchers_per_key}",
+         n * watchers_per_key, time.perf_counter() - t0, _rss_kb())
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny sizes (CI smoke)")
+    args = ap.parse_args(argv)
+    n = 1_000 if args.quick else 50_000
+    wn = 200 if args.quick else 10_000
+    for size in (128, 1024, 4096):
+        bench_set(n, size)
+    bench_delete(n)
+    bench_watch(wn, 1)
+    bench_watch(wn // 4, 4)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

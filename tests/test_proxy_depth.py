@@ -1,0 +1,142 @@
+"""Proxy-mode depth tests (reference proxy/ has 535 test LoC:
+reverse_test.go header handling, director_test.go failure marking)."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from etcd_tpu.api.http import serve
+from etcd_tpu.api.proxy import NewProxyHandler, SINGLE_HOP_HEADERS
+
+
+class _Upstream(BaseHTTPRequestHandler):
+    """Records the request it saw; replies with canned JSON."""
+
+    seen: list[dict] = []
+    fail = False
+
+    def _handle(self):
+        if _Upstream.fail:
+            self.send_error(500)
+            return
+        _Upstream.seen.append({
+            "path": self.path,
+            "method": self.command,
+            "headers": dict(self.headers),
+        })
+        body = json.dumps({"ok": True}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("Connection", "keep-alive")  # single-hop
+        self.send_header("X-Upstream", "yes")
+        self.end_headers()
+        self.wfile.write(body)
+
+    do_GET = do_PUT = do_POST = do_DELETE = _handle
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def upstream():
+    _Upstream.seen = []
+    _Upstream.fail = False
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Upstream)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+@pytest.fixture
+def proxy(upstream):
+    handler = NewProxyHandler([upstream])
+    httpd = serve(handler, "127.0.0.1", 0)
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+def test_hop_by_hop_headers_stripped(proxy):
+    """reverse.go:15-30: the stdlib-borrowed singleHopHeaders list
+    (which deliberately excludes Proxy-Connection) is removed from
+    the forwarded request; end-to-end headers pass through."""
+    req = urllib.request.Request(proxy + "/v2/keys/a", headers={
+        "Connection": "keep-alive",
+        "Keep-Alive": "timeout=5",
+        "Upgrade": "websocket",
+        "X-Custom": "pass-through",
+    })
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.status == 200
+        assert resp.headers.get("X-Upstream") == "yes"
+    seen = _Upstream.seen[0]["headers"]
+    # the client's hop-by-hop values never reach the upstream (the
+    # Connection header present there is urllib's own outbound one)
+    assert seen.get("Connection") != "keep-alive"
+    assert "Keep-Alive" not in seen
+    assert "Upgrade" not in seen
+    assert seen.get("X-Custom") == "pass-through"
+
+
+def test_x_forwarded_for_appended(proxy):
+    urllib.request.urlopen(proxy + "/v2/keys/a", timeout=10).read()
+    assert _Upstream.seen[0]["headers"]["X-Forwarded-For"] \
+        == "127.0.0.1"
+    # an existing chain is extended, not replaced (reverse.go:107-118)
+    req = urllib.request.Request(
+        proxy + "/v2/keys/b",
+        headers={"X-Forwarded-For": "10.9.8.7"})
+    urllib.request.urlopen(req, timeout=10).read()
+    assert _Upstream.seen[1]["headers"]["X-Forwarded-For"] \
+        == "10.9.8.7, 127.0.0.1"
+
+
+def test_endpoint_down_502_then_quarantined_503():
+    """First attempt tries the dead endpoint: 502 Bad Gateway; the
+    failure quarantines it, so the next request sees zero available
+    endpoints: 503 (proxy.go/director.go status split)."""
+    handler = NewProxyHandler(["127.0.0.1:1"])  # nothing listens
+    httpd = serve(handler, "127.0.0.1", 0)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/v2/keys/a", timeout=10)
+        assert ei.value.code == 502
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/v2/keys/a", timeout=10)
+        assert ei.value.code == 503
+    finally:
+        httpd.shutdown()
+
+
+def test_failed_endpoint_quarantined_then_recovers(upstream):
+    """director.go:86-93: a failed endpoint is skipped for 5s, then
+    retried; with an injectable clock we just verify the mark."""
+    from etcd_tpu.api.proxy import Director
+
+    d = Director("http", [upstream, "127.0.0.1:1"])
+    eps = d.endpoints()
+    assert len(eps) == 2
+    # mark the dead one failed: filtered out immediately
+    dead = [e for e in eps if e.url.endswith(":1")][0]
+    dead.failed()
+    assert all(not e.url.endswith(":1") for e in d.endpoints())
+    # un-failing restores it (the timer does this after 5s)
+    dead.available = True
+    assert any(e.url.endswith(":1") for e in d.endpoints())
+
+
+def test_single_hop_header_list_is_title_cased():
+    # guard: the filter compares title-cased names
+    assert all(h == h.title() for h in SINGLE_HOP_HEADERS)
+
+
+def test_post_body_forwarded(proxy):
+    req = urllib.request.Request(
+        proxy + "/v2/keys/body", data=b"value=hello", method="PUT")
+    urllib.request.urlopen(req, timeout=10).read()
+    assert _Upstream.seen[0]["method"] == "PUT"

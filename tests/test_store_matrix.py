@@ -1,0 +1,233 @@
+"""Store behavior matrix — the deep edge-case table the reference
+covers in store/store_test.go (2.4k LoC): error-code vocabulary,
+dir/file distinctions, CAS/CAD variants, TTL-on-dir expiry, sorted
+ordering, event-index bookkeeping, watch ancestry."""
+
+import time
+
+import pytest
+
+from etcd_tpu.store import Store
+from etcd_tpu.utils.errors import (
+    EtcdError,
+    ECODE_DIR_NOT_EMPTY,
+    ECODE_KEY_NOT_FOUND,
+    ECODE_NODE_EXIST,
+    ECODE_NOT_DIR,
+    ECODE_NOT_FILE,
+    ECODE_ROOT_RONLY,
+    ECODE_TEST_FAILED,
+)
+
+
+def _err(call, code):
+    with pytest.raises(EtcdError) as ei:
+        call()
+    assert ei.value.error_code == code, ei.value
+    return ei.value
+
+
+# -- error-code matrix (error.go:68-100 vocabulary) ----------------------
+
+
+def test_get_missing_is_100():
+    s = Store()
+    s.create("/seed", False, "v", False, None)  # advance the index
+    e = _err(lambda: s.get("/missing", False, False),
+             ECODE_KEY_NOT_FOUND)
+    # errors carry the current etcd index (error.go:137 parity)
+    assert e.index == s.current_index > 0
+
+
+def test_update_missing_is_100():
+    s = Store()
+    _err(lambda: s.update("/nope", "v", None), ECODE_KEY_NOT_FOUND)
+
+
+def test_delete_missing_is_100():
+    s = Store()
+    _err(lambda: s.delete("/nope", False, False), ECODE_KEY_NOT_FOUND)
+
+
+def test_cas_missing_is_100_and_mismatch_101():
+    s = Store()
+    _err(lambda: s.compare_and_swap("/nope", "x", 0, "y", None),
+         ECODE_KEY_NOT_FOUND)
+    s.create("/k", False, "v1", False, None)
+    e = _err(lambda: s.compare_and_swap("/k", "WRONG", 0, "y", None),
+             ECODE_TEST_FAILED)
+    assert "WRONG" in str(e.cause)  # cause names the failed compare
+    _err(lambda: s.compare_and_swap("/k", "", 999, "y", None),
+         ECODE_TEST_FAILED)
+
+
+def test_cad_mismatch_101_then_success():
+    s = Store()
+    s.create("/k", False, "v1", False, None)
+    _err(lambda: s.compare_and_delete("/k", "bad", 0),
+         ECODE_TEST_FAILED)
+    ev = s.compare_and_delete("/k", "v1", 0)
+    assert ev.action == "compareAndDelete"
+
+
+def test_create_on_existing_105():
+    s = Store()
+    s.create("/k", False, "v", False, None)
+    _err(lambda: s.create("/k", False, "v2", False, None),
+         ECODE_NODE_EXIST)
+
+
+def test_file_ops_on_dir_102():
+    s = Store()
+    s.create("/d", True, "", False, None)
+    _err(lambda: s.update("/d", "v", None), ECODE_NOT_FILE)
+    _err(lambda: s.compare_and_swap("/d", "a", 0, "b", None),
+         ECODE_NOT_FILE)
+    _err(lambda: s.compare_and_delete("/d", "a", 0), ECODE_NOT_FILE)
+    # plain delete of a dir without dir/recursive is also NOT_FILE
+    _err(lambda: s.delete("/d", False, False), ECODE_NOT_FILE)
+
+
+def test_create_under_file_104():
+    s = Store()
+    s.create("/f", False, "v", False, None)
+    _err(lambda: s.create("/f/child", False, "v", False, None),
+         ECODE_NOT_DIR)
+
+
+def test_delete_nonempty_dir_108_then_recursive_wins():
+    s = Store()
+    s.create("/d/inner", False, "v", False, None)
+    _err(lambda: s.delete("/d", True, False), ECODE_DIR_NOT_EMPTY)
+    ev = s.delete("/d", True, True)
+    assert ev.action == "delete"
+    _err(lambda: s.get("/d/inner", False, False), ECODE_KEY_NOT_FOUND)
+
+
+def test_root_operations_107():
+    s = Store()
+    _err(lambda: s.delete("/", True, True), ECODE_ROOT_RONLY)
+    _err(lambda: s.set("/", False, "v", None), ECODE_ROOT_RONLY)
+
+
+# -- dirs, ordering, indices ---------------------------------------------
+
+
+def test_sorted_get_orders_children():
+    s = Store()
+    for name in ("zz", "aa", "mm"):
+        s.create(f"/dir/{name}", False, name, False, None)
+    ev = s.get("/dir", False, True)
+    keys = [n.key for n in ev.node.nodes]
+    assert keys == sorted(keys)
+
+
+def test_set_dir_over_file_and_value_over_dir():
+    s = Store()
+    s.create("/x", False, "v", False, None)
+    # set(dir=True) over an existing FILE replaces it with a dir
+    ev = s.set("/x", True, "", None)
+    assert ev.node.dir
+    # and set(file) over the now-dir is NOT_FILE (matches reference
+    # Set semantics routed through create-or-replace)
+    _err(lambda: s.update("/x", "v", None), ECODE_NOT_FILE)
+
+
+def test_event_index_tracks_store_index():
+    s = Store()
+    e1 = s.create("/a", False, "1", False, None)
+    e2 = s.set("/a", False, "2", None)
+    e3 = s.delete("/a", False, False)
+    assert e1.node.created_index < e2.node.modified_index \
+        < e3.node.modified_index
+    assert e3.node.modified_index == s.current_index
+
+
+def test_in_order_post_keys_numeric_and_unpadded():
+    """Reference parity quirk: unique-create keys are the UNPADDED
+    store index (store.go internalCreate), so they sort numerically
+    by creation but NOT lexically once past 9 entries."""
+    s = Store()
+    keys = []
+    for i in range(12):
+        ev = s.create("/q", False, f"v{i}", True, None)
+        keys.append(int(ev.node.key.rsplit("/", 1)[1]))
+    assert keys == sorted(keys)  # strictly increasing indices
+    assert len(set(keys)) == 12
+
+
+def test_update_refreshes_ttl_keeps_value_semantics():
+    s = Store()
+    s.create("/t", False, "v", False, time.time() + 100)
+    ev = s.update("/t", "v2", time.time() + 0.05)
+    assert ev.node.ttl <= 1
+    s.delete_expired_keys(time.time() + 1)
+    _err(lambda: s.get("/t", False, False), ECODE_KEY_NOT_FOUND)
+
+
+def test_dir_ttl_expires_children():
+    s = Store()
+    s.create("/tmp", True, "", False, time.time() + 0.05)
+    s.create("/tmp/a", False, "v", False, None)
+    s.delete_expired_keys(time.time() + 1)
+    _err(lambda: s.get("/tmp/a", False, False), ECODE_KEY_NOT_FOUND)
+    _err(lambda: s.get("/tmp", False, False), ECODE_KEY_NOT_FOUND)
+
+
+def test_expire_fires_watcher_with_expire_action():
+    s = Store()
+    s.create("/e", False, "v", False, time.time() + 0.05)
+    w = s.watch("/e", False, False, 0)
+    s.delete_expired_keys(time.time() + 1)
+    ev = w.next_event(timeout=5)
+    assert ev.action == "expire"
+
+
+def test_recursive_get_depth_and_hidden_skip():
+    s = Store()
+    s.create("/r/a/b/c", False, "deep", False, None)
+    s.create("/r/_hidden/x", False, "h", False, None)
+    ev = s.get("/r", True, True)
+
+    def walk(n, acc):
+        for c in n.nodes or []:
+            acc.append(c.key)
+            walk(c, acc)
+    acc = []
+    walk(ev.node, acc)
+    assert "/r/a/b/c" in acc
+    assert not any("_hidden" in k for k in acc)
+
+
+def test_watch_ancestor_fires_recursive_only():
+    s = Store()
+    w_rec = s.watch("/p", True, False, 0)
+    w_flat = s.watch("/p", False, False, 0)
+    s.create("/p/child/leaf", False, "v", False, None)
+    assert w_rec.next_event(timeout=5).node.key == "/p/child/leaf"
+    assert w_flat.next_event(timeout=0.2) is None  # non-recursive
+
+
+def test_cas_by_index_only():
+    s = Store()
+    ev = s.create("/i", False, "v1", False, None)
+    idx = ev.node.modified_index
+    ev2 = s.compare_and_swap("/i", "", idx, "v2", None)
+    assert ev2.node.value == "v2"
+    # stale index now fails
+    _err(lambda: s.compare_and_swap("/i", "", idx, "v3", None),
+         ECODE_TEST_FAILED)
+
+
+def test_stats_count_failures_too():
+    s = Store()
+    s.create("/s", False, "v", False, None)
+    try:
+        s.create("/s", False, "v", False, None)
+    except EtcdError:
+        pass
+    import json
+
+    st = json.loads(s.json_stats())
+    assert st["createSuccess"] >= 1
+    assert st["createFail"] >= 1
