@@ -155,16 +155,18 @@ def raw_crc_batch(buf, use_pallas: bool | None = None) -> jnp.ndarray:
     return _raw_crc_jit(buf, c, use_pallas=use_pallas)
 
 
-@jax.jit
-def shift_crc_batch(states: jnp.ndarray, lens: jnp.ndarray) -> jnp.ndarray:
+@functools.partial(jax.jit, static_argnames=("nbits",))
+def shift_crc_batch(states: jnp.ndarray, lens: jnp.ndarray,
+                    nbits: int = 32) -> jnp.ndarray:
     """``Z^lens[i] @ states[i]`` elementwise: uint32 [N].
 
-    Loops over the bits of ``lens`` (static 32-iteration bound: the
-    full uint32 range, i.e. shifts up to 4 GiB - 1) with masked
-    [N,32]@[32,32] parity matmuls — the device form of
+    Loops over the bits of ``lens`` (default static bound 32: the full
+    uint32 range, i.e. shifts up to 4 GiB - 1; callers with a known
+    length ceiling pass a smaller ``nbits`` — e.g. WAL-record verify
+    with <=512 B rows needs 10 masked matmul rounds, not 32) with
+    masked [N,32]@[32,32] parity matmuls — the device form of
     gf2.combine_batch.
     """
-    nbits = 32
     zp = jnp.asarray(_zpow_stack(nbits))  # [nbits, 32, 32] int8
     bits = _to_bits32(jnp.asarray(states, dtype=jnp.uint32))  # [N, 32]
     lens = jnp.asarray(lens, dtype=jnp.uint32)
@@ -193,16 +195,18 @@ def crc32c_batch(buf, lens, use_pallas: bool | None = None) -> jnp.ndarray:
     return raw ^ jnp.take(atab, lens, axis=0)
 
 
-@jax.jit
+@functools.partial(jax.jit, static_argnames=("nbits",))
 def _chain_expected(prev_stored: jnp.ndarray, raw: jnp.ndarray,
-                    lens: jnp.ndarray) -> jnp.ndarray:
+                    lens: jnp.ndarray,
+                    nbits: int = 32) -> jnp.ndarray:
     """update(prev_stored[i], m_i) given raw CRCs: uint32 [N]."""
     inv = prev_stored ^ jnp.uint32(_MASK32)
-    shifted = shift_crc_batch(inv, lens)
+    shifted = shift_crc_batch(inv, lens, nbits=nbits)
     return shifted ^ raw ^ jnp.uint32(_MASK32)
 
 
-def chain_verify_device(seed: int, stored, raw, lens) -> jnp.ndarray:
+def chain_verify_device(seed: int, stored, raw, lens,
+                        max_len: int | None = None) -> jnp.ndarray:
     """Parallel rolling-chain verification: bool [N].
 
     ``stored[i]`` is the CRC recorded in record i (must equal
@@ -215,22 +219,26 @@ def chain_verify_device(seed: int, stored, raw, lens) -> jnp.ndarray:
         return jnp.zeros((0,), dtype=bool)
     prev = jnp.concatenate(
         [jnp.asarray([seed], dtype=jnp.uint32), stored[:-1]])
-    return chain_links_device(prev, stored, raw, lens)
+    return chain_links_device(prev, stored, raw, lens, max_len=max_len)
 
 
-def chain_links_device(prev, stored, raw, lens) -> jnp.ndarray:
+def chain_links_device(prev, stored, raw, lens,
+                       max_len: int | None = None) -> jnp.ndarray:
     """Link-wise chain verification with an explicit prev vector:
     bool [N] where ``update(prev[i], data_i) == stored[i]``.
 
     The general (multi-stream) form: rows from many independent
     chains — e.g. every co-hosted group's WAL in one batch — verify
     together because each link only needs its own predecessor's
-    stored value.
+    stored value.  ``max_len``, when known statically (the padded row
+    width), bounds the seed-shift loop to ``ceil(log2(max_len+1))``
+    masked matmuls instead of 32.
     """
     prev = jnp.asarray(prev, dtype=jnp.uint32)
     if prev.size == 0:
         return jnp.zeros((0,), dtype=bool)
     raw = jnp.asarray(raw, dtype=jnp.uint32)
     lens = jnp.asarray(lens, dtype=jnp.uint32)
-    return _chain_expected(prev, raw, lens) == \
+    nbits = 32 if max_len is None else max(1, int(max_len).bit_length())
+    return _chain_expected(prev, raw, lens, nbits=nbits) == \
         jnp.asarray(stored, dtype=jnp.uint32)

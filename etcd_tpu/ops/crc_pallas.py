@@ -19,7 +19,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-TILE = 1024
+# Empirically fastest on hardware at the bench's L=384 shape: an
+# on-chip sweep (scripts/pallas_sweep.py, axon v5e tunnel) measured
+# t512 5.58 / t1024 4.33 / t2048 3.00 GB/s for this kernel.
+TILE = 512
 # VMEM budget for the per-tile bit expansion ([TILE, 8L] int8 plus the
 # [TILE, L] int32 byte tile ≈ 12*TILE*L bytes). Tiles shrink for wide
 # records so multi-KB payloads still compile; ~6 MB leaves headroom in
