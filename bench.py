@@ -296,15 +296,16 @@ def run_extra_configs(extra: dict, backend: str) -> None:
             log(f"config4 failed: {e!r}")
 
 
-def measure_sustained(jax, rows, lens, stored, prev, iters):
+def measure_sustained(jax, rows, stored, iters):
     """Sustained per-chip replay throughput over HBM-resident data.
 
     The axon tunnel used by this harness adds ~65-80 ms per dispatch,
     ~0.5 GB/s H2D and ~16 MB/s D2H — artifacts a real TPU host link
     does not have (PCIe/local DMA: tens of GB/s).  To measure what the
     *chip* sustains, the batch stays device-resident and the full
-    verify computation (per-record raw CRC + rolling-chain link check,
-    wal/decoder.go:28-47 semantics) loops on device.  Each iteration
+    verify computation (seed-injected raw CRC == the rolling-chain
+    check, wal/decoder.go:28-47 semantics — see
+    ops/crc_device.py:inject_seeds) loops on device.  Each iteration
     XORs the input with the loop index so XLA cannot hoist the body
     out of the loop; only iteration 0 (the unperturbed rows) feeds the
     correctness gate.  One scalar fetch at the end is the only sync.
@@ -316,7 +317,6 @@ def measure_sustained(jax, rows, lens, stored, prev, iters):
     import jax.numpy as jnp
 
     from etcd_tpu.ops.crc_device import (
-        _chain_expected,
         _default_use_pallas,
         _raw_crc_jit,
         contribution_matrix,
@@ -324,21 +324,17 @@ def measure_sustained(jax, rows, lens, stored, prev, iters):
 
     c = jnp.asarray(contribution_matrix(rows.shape[1]))
     drows = jax.device_put(rows)
-    dlens = jax.device_put(lens.astype(np.uint32))
     dstored = jax.device_put(np.asarray(stored, np.uint32))
-    dprev = jax.device_put(np.asarray(prev, np.uint32))
     use_pallas = os.environ.get("BENCH_USE_PALLAS")
     use_pallas = (_default_use_pallas() if use_pallas is None
                   else use_pallas == "1")
 
-    nbits = max(1, int(rows.shape[1]).bit_length())
-
     @functools.partial(jax.jit, static_argnames=("k", "up"))
-    def loop(rows, lens, stored, prev, c, k, up):
+    def loop(rows, stored, c, k, up):
         def body(i, acc):
             buf = rows ^ i.astype(jnp.uint8)
             raw = _raw_crc_jit(buf, c, use_pallas=up)
-            ok = _chain_expected(prev, raw, lens, nbits=nbits) == stored
+            ok = (raw ^ jnp.uint32(0xFFFFFFFF)) == stored
             n_ok = jnp.sum(ok, dtype=jnp.int32)
             return acc + jnp.where(i == 0, n_ok, 0)
 
@@ -346,10 +342,9 @@ def measure_sustained(jax, rows, lens, stored, prev, iters):
 
     # warm with the SAME static k — a different k is a different
     # executable, and its compile must not land in the timed region
-    int(loop(drows, dlens, dstored, dprev, c, iters, use_pallas))
+    int(loop(drows, dstored, c, iters, use_pallas))
     t0 = time.perf_counter()
-    n_ok = int(loop(drows, dlens, dstored, dprev, c, iters,
-                    use_pallas))
+    n_ok = int(loop(drows, dstored, c, iters, use_pallas))
     dt = time.perf_counter() - t0
     return rows.shape[0] * iters / dt, n_ok
 
@@ -427,43 +422,51 @@ def main():
 
     import jax.numpy as jnp
 
-    from etcd_tpu.ops.crc_device import chain_links_device, raw_crc_batch
+    from etcd_tpu.ops.crc_device import (
+        chain_links_injected,
+        inject_seeds,
+        raw_crc_batch,
+    )
 
     backend = jax.default_backend()
     degraded = backend == "cpu"
     log(f"jax backend: {backend}, host threads: {THREADS}")
 
     def scan_pad(arg):
+        """Host tier: native framing scan, padded-row build, and the
+        seed injection that turns the rolling chain into a pure raw
+        CRC (ops/crc_device.py:inject_seeds) — all cheap vectorized
+        byte work, parallel across groups."""
         g, blob = arg
         seed = g * 2654435761 & 0xFFFFFFFF
         types, crcs, doff, dlen, *_ = native.wal_scan(blob)
-        width = -(-int(dlen.max()) // 128) * 128
+        # 4 spare columns hold the injected seed bytes
+        width = -(-(int(dlen.max()) + 4) // 128) * 128
         rows = native.pad_rows(blob, doff, dlen, width)
         prev = np.concatenate(
             [np.asarray([seed], np.uint32), crcs[:-1]])
-        return rows, dlen.astype(np.uint32), crcs, prev
+        inject_seeds(rows, dlen, prev)
+        return rows, crcs
 
     def assemble(pool):
         """Parallel host scans+padding -> one concatenated batch."""
         parts = list(pool.map(scan_pad, enumerate(blobs)))
         width = max(p[0].shape[1] for p in parts)
         if any(p[0].shape[1] != width for p in parts):
-            parts = [(np.pad(r, ((0, 0), (width - r.shape[1], 0))),
-                      l, c, pv) for r, l, c, pv in parts]
+            parts = [(np.pad(r, ((0, 0), (width - r.shape[1], 0))), c)
+                     for r, c in parts]
         return (np.concatenate([p[0] for p in parts]),
-                np.concatenate([p[1] for p in parts]),
-                np.concatenate([p[2] for p in parts]),
-                np.concatenate([p[3] for p in parts]))
+                np.concatenate([p[1] for p in parts]))
 
     def device_verify(batch):
-        """One batched device CRC + chain-link pass over all groups'
-        records; the only sync is a scalar ok-count fetch (the tunnel
-        transfers D2H at ~16 MB/s — a [N] bool fetch would dominate
-        the measurement with transport artifact)."""
-        rows, lens, stored, prev = batch
+        """One batched device CRC pass over all groups' records (the
+        chain check rides the injected seeds); the only sync is a
+        scalar ok-count fetch (the tunnel transfers D2H at ~16 MB/s —
+        a [N] bool fetch would dominate the measurement with
+        transport artifact)."""
+        rows, stored = batch
         raw = raw_crc_batch(rows)
-        ok = chain_links_device(prev, stored, raw, lens,
-                                max_len=rows.shape[1])
+        ok = chain_links_injected(raw, stored)
         n_ok = int(jnp.sum(ok, dtype=jnp.int32))
         assert n_ok == rows.shape[0], (n_ok, rows.shape[0])
         return n_ok
@@ -493,13 +496,14 @@ def main():
     sus_eps = None
     if not degraded:
         try:
-            sus_eps, n_ok = measure_sustained(jax, *batch,
+            sus_eps, n_ok = measure_sustained(jax, batch[0], batch[1],
                                               iters=SUSTAIN_ITERS)
             assert n_ok == total_entries, (n_ok, total_entries)
             log(f"device-sustained: {sus_eps / 1e6:.2f}M entries/s "
                 f"({SUSTAIN_ITERS} resident passes, raw CRC + chain "
                 f"verify, single scalar sync)")
         except Exception as e:
+            sus_eps = None  # a failed gate must not promote a number
             log(f"sustained measurement failed: {e!r}")
 
     extra = {"backend": backend, "probe": probe_info}

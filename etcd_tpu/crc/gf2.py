@@ -75,6 +75,26 @@ for _ in range(62):
     _POWERS.append(matmul(_POWERS[-1], _POWERS[-1]))
 
 
+def inverse(m: np.ndarray) -> np.ndarray:
+    """Invert a [32,32] GF(2) matrix by Gaussian elimination.
+
+    Every ``Z^k`` is invertible (processing zero bytes is a bijection
+    on CRC states), so this never fails for the operators built here;
+    raises ValueError on a singular input.
+    """
+    a = np.concatenate([m.astype(np.uint8) & 1, identity()], axis=1)
+    n = m.shape[0]
+    for col in range(n):
+        piv = col + int(np.argmax(a[col:, col]))
+        if a[piv, col] == 0:
+            raise ValueError("singular GF(2) matrix")
+        if piv != col:
+            a[[col, piv]] = a[[piv, col]]
+        elim = (a[:, col] == 1) & (np.arange(n) != col)
+        a[elim] ^= a[col]
+    return a[:, n:].copy()
+
+
 def zero_operator(nbytes: int) -> np.ndarray:
     """Z^nbytes — advance a CRC state across nbytes of zeros."""
     m = identity()

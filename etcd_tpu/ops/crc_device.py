@@ -222,6 +222,78 @@ def chain_verify_device(seed: int, stored, raw, lens,
     return chain_links_device(prev, stored, raw, lens, max_len=max_len)
 
 
+# -- seed injection: the zero-matmul chain verify ----------------------------
+#
+# CRC is GF(2)-linear, so the seeded update can be folded INTO the raw
+# matmul instead of fixed up after it:
+#
+#   update(prev, m) = Z^len(m) @ (prev ^ ~0) ^ raw(m) ^ ~0
+#
+# and feeding the 4 little-endian bytes of a value v into a zero CRC
+# state yields Z4 @ v (Z4 = the 4-zero-byte operator).  Writing
+# p' = Z4^-1 @ (prev ^ ~0) into the 4 padding bytes immediately left
+# of each right-aligned record makes the plain raw CRC of the row
+#
+#   raw(p'_bytes ++ m) = Z^len @ Z4 @ Z4^-1 @ (prev ^ ~0) ^ raw(m)
+#                      = Z^len(prev ^ ~0) ^ raw(m)
+#
+# i.e. update(prev, m) ^ ~0 — the chained value, with NO per-record
+# shift matmuls on device (shift_crc_batch runs ~10 masked [N,32]@
+# [32,32] rounds; on hardware that costs ~3x the raw matmul itself).
+# The 4-byte writes are a vectorized host scatter into padding the
+# rows already carry.
+
+
+@functools.lru_cache(maxsize=1)
+def _z4inv_tables() -> np.ndarray:
+    """[4, 256] uint32: t[k][b] = Z4^-1 @ (b << 8k) — evaluates
+    Z4^-1 @ x with 4 byte-table lookups."""
+    z4inv = gf2.inverse(gf2.zero_operator(4))
+    t = np.empty((4, 256), np.uint32)
+    for k in range(4):
+        for b in range(256):
+            t[k, b] = gf2.matvec(z4inv, b << (8 * k))
+    return t
+
+
+def inject_seeds(rows: np.ndarray, lens, prev) -> np.ndarray:
+    """Write Z4^-1(prev ^ ~0) into each row's 4 padding bytes just
+    left of its record (host, vectorized, in place).  After this,
+
+        raw_crc_batch(rows) ^ 0xFFFFFFFF == update(prev[i], m_i)
+
+    so the whole rolling-chain verify is one raw-CRC matmul plus an
+    elementwise compare against the stored CRCs (decoder.go:28-47
+    semantics with zero extra device work).  Requires 4 bytes of
+    padding: lens + 4 <= rows.shape[1].
+    """
+    lens = np.asarray(lens, np.int64)
+    n, w = rows.shape
+    if n == 0:
+        return rows
+    if int(lens.max()) + 4 > w:
+        raise ValueError(f"need 4 padding bytes: max len "
+                         f"{int(lens.max())} + 4 > width {w}")
+    t = _z4inv_tables()
+    x = np.asarray(prev, np.uint32) ^ np.uint32(_MASK32)
+    y = (t[0, x & 0xFF] ^ t[1, (x >> 8) & 0xFF]
+         ^ t[2, (x >> 16) & 0xFF] ^ t[3, (x >> 24) & 0xFF])
+    cols = (w - lens - 4)[:, None] + np.arange(4)
+    vals = (y[:, None] >> (8 * np.arange(4, dtype=np.uint32))
+            ).astype(np.uint8)
+    rows[np.arange(n)[:, None], cols] = vals
+    return rows
+
+
+def chain_links_injected(rows_raw: jnp.ndarray, stored) -> jnp.ndarray:
+    """Chain verification for seed-injected rows: bool [N].
+
+    ``rows_raw`` is ``raw_crc_batch`` output for rows prepared by
+    :func:`inject_seeds`; ``stored`` the recorded CRCs."""
+    return (rows_raw ^ jnp.uint32(_MASK32)) == \
+        jnp.asarray(stored, dtype=jnp.uint32)
+
+
 def chain_links_device(prev, stored, raw, lens,
                        max_len: int | None = None) -> jnp.ndarray:
     """Link-wise chain verification with an explicit prev vector:

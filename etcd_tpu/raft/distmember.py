@@ -1,0 +1,406 @@
+"""One member slot of G co-hosted raft groups, for cross-host
+replication (SURVEY §5.8's two-tier design composed).
+
+`MultiRaft` (multiraft.py) fuses all M members of every group into
+one process — maximal device batching, but the whole cluster shares
+process fate (VERDICT r2 missing #2).  This module is the other half:
+each HOST owns ONE member slot of all G groups, rounds exchange
+batched [G] message frames (wire/distmsg.py) over the host DCN tier,
+and every device transition reuses the same batched engine ops
+(raft/batched.py) the fused runtime uses — `maybe_append`,
+`leader_append`, `progress_update`, `maybe_commit`, `grant_vote`,
+`restore_snapshot` — applied to a single slot's GroupState.
+
+Protocol parity: the exchange IS the reference's message protocol
+(msgApp/msgAppResp/msgVote/msgVoteResp/msgSnap semantics,
+raft/raft.go:372-520) with the group axis batched; drop tolerance is
+the reference's fire-and-forget contract (server.go:202-206) — any
+frame may vanish, progress resumes on a later round.
+
+Durability is the CALLER's job (the server layer persists entries,
+ballots and frontiers to its WAL before acks/responses leave the
+host — the Ready contract, node.go:41-60); this class is pure
+consensus state.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..wire.distmsg import AppendBatch, AppendResp, VoteReq, VoteResp
+from .batched import (
+    FOLLOWER,
+    LEADER,
+    CANDIDATE,
+    GroupState,
+    apply_conf_change as conf_change_batch,
+    compact as compact_batch,
+    grant_vote,
+    init_groups,
+    leader_append,
+    maybe_append,
+    maybe_commit,
+    progress_update,
+    restore_snapshot,
+    term_at,
+    tick as tick_batch,
+)
+
+
+@jax.jit
+def _adopt_term(state: GroupState, msg_term, lead, active):
+    """Higher-term message handling (raft.go:388-396): adopt the term,
+    become follower, forget the vote; ``lead`` [G] i32 is the new
+    leader slot to record (-1 for vote traffic)."""
+    higher = active & (msg_term > state.term)
+    return state._replace(
+        term=jnp.where(higher, msg_term, state.term),
+        vote=jnp.where(higher, -1, state.vote),
+        role=jnp.where(higher, FOLLOWER, state.role),
+        lead=jnp.where(higher, lead, state.lead))
+
+
+@jax.jit
+def _absorb_resp(state: GroupState, peer, term, ok, acked, hint,
+                 active):
+    """Leader absorbing one peer's batched msgAppResp: step down on
+    higher terms, progress-update ok lanes, repair next_ from the
+    commit hint on rejects, then quorum-commit."""
+    state = _adopt_term(state, term, jnp.full_like(term, -1), active)
+    g, m = state.match.shape
+    peer_v = jnp.full((g,), peer, jnp.int32)
+    state = progress_update(state, peer_v, acked,
+                            active=active & ok)
+    onehot = jnp.arange(m) == peer
+    reject = active & ~ok & (state.role == LEADER)
+    repaired = jnp.maximum(hint + 1, 1)
+    next_ = jnp.where(reject[:, None] & onehot[None, :],
+                      jnp.minimum(state.next_, repaired[:, None]),
+                      state.next_)
+    state = state._replace(next_=next_)
+    return maybe_commit(state)
+
+
+@partial(jax.jit, static_argnames=("slot",))
+def _begin_campaign(state: GroupState, mask, slot):
+    """term+1, vote self, CANDIDATE (raft.go:358-362 batched)."""
+    mask = mask & state.members[:, slot]
+    lterm = term_at(state.log_term, state.offset, state.last,
+                    state.last)
+    return state._replace(
+        term=state.term + mask.astype(jnp.int32),
+        role=jnp.where(mask, CANDIDATE, state.role),
+        vote=jnp.where(mask, slot, state.vote),
+        elapsed=jnp.where(mask, 0, state.elapsed)), mask, lterm
+
+
+@partial(jax.jit, static_argnames=("slot",))
+def _become_leader(state: GroupState, won, slot):
+    """Winner lanes become leader (raft.go:329-348 batched); the
+    becoming-leader empty entry is appended by the caller via
+    propose()."""
+    m = state.match.shape[1]
+    return state._replace(
+        role=jnp.where(won, LEADER, state.role),
+        lead=jnp.where(won, slot, state.lead),
+        match=jnp.where(won[:, None], 0, state.match),
+        next_=jnp.where(won[:, None], state.last[:, None] + 1,
+                        state.next_))
+
+
+class DistMember:
+    """Member ``slot`` of G co-hosted groups; peers live on other
+    hosts and exchange wire/distmsg.py frames."""
+
+    def __init__(self, g: int, m: int, slot: int, cap: int,
+                 election: int = 10, max_batch_ents: int = 8,
+                 seed: int | None = None):
+        # (election is in ticks; the server layer's tick_interval
+        # scales it to wall time — raft.go:611-617 randomization)
+        self.g, self.m, self.slot, self.cap = g, m, slot, cap
+        self.e = max_batch_ents
+        rng = np.random.default_rng(slot if seed is None else seed)
+        st = init_groups(g, m, cap, election=election)
+        st = st._replace(timeout=jnp.asarray(
+            rng.integers(election, 2 * election, size=g), jnp.int32))
+        self.state = st
+        # host-side payload ring: per-group {index: bytes}; a follower
+        # keeps payloads too — it applies them at commit
+        self.payloads: list[dict[int, bytes]] = [dict()
+                                                 for _ in range(g)]
+        self.errors = {"overflow": np.zeros(g, bool),
+                       "conflict": np.zeros(g, bool)}
+
+    # -- views ------------------------------------------------------------
+
+    def is_leader(self) -> np.ndarray:
+        return np.asarray(self.state.role) == LEADER
+
+    def leader_hint(self) -> np.ndarray:
+        """[G] member slot believed to lead each group (-1 none)."""
+        return np.asarray(self.state.lead)
+
+    def commit_index(self) -> np.ndarray:
+        return np.asarray(self.state.commit)
+
+    def terms(self) -> np.ndarray:
+        return np.asarray(self.state.term)
+
+    def commit_terms(self) -> np.ndarray:
+        """[G] term of the entry AT each commit index — what frontier
+        markers and snapshots must record: a restarted/installed
+        follower seeds its log slot 0 with this value, and the
+        leader's append match at prev=frontier compares against the
+        ENTRY's term, not the group's current term."""
+        st = self.state
+        return np.asarray(term_at(st.log_term, st.offset, st.last,
+                                  st.commit))
+
+    def terms_at(self, idx: np.ndarray) -> np.ndarray:
+        """[G] term of the entry at ``idx`` per group (0 outside the
+        retained window)."""
+        st = self.state
+        return np.asarray(term_at(st.log_term, st.offset, st.last,
+                                  jnp.asarray(idx, jnp.int32)))
+
+    def committed_payload(self, group: int, index: int):
+        return self.payloads[group].get(index)
+
+    # -- leader path ------------------------------------------------------
+
+    def propose(self, n_new: np.ndarray,
+                data: list[list[bytes]] | None = None):
+        """Append ``n_new[g]`` entries on lanes where this slot leads.
+        Returns (valid, base): which lanes accepted, and each lane's
+        pre-append last index (keys the caller's bookkeeping)."""
+        st = self.state
+        base = np.asarray(st.last)
+        lead = self.is_leader()
+        st, err = leader_append(
+            st, jnp.asarray(np.asarray(n_new, np.int32)),
+            jnp.full((self.g,), self.slot, jnp.int32))
+        self.state = st
+        overflow = np.asarray(err)
+        self.errors["overflow"] = overflow
+        valid = lead & (np.asarray(n_new) > 0) & ~overflow
+        if data is not None:
+            for gi in np.nonzero(valid)[0]:
+                for j, blob in enumerate(data[gi][:int(n_new[gi])]):
+                    self.payloads[gi][int(base[gi]) + 1 + j] = blob
+        return valid, base
+
+    def build_append(self, peer: int) -> AppendBatch | None:
+        """The batched msgApp frame for ``peer``: every lane this slot
+        leads sends its window [next_[peer], min(next+E-1, last)] (or
+        a need_snap flag past compaction, raft.go:207-209)."""
+        st = self.state
+        lead = self.is_leader()
+        member = np.asarray(st.members)[:, peer]
+        active = lead & member
+        if not active.any():
+            return None
+        nxt = np.asarray(st.next_)[:, peer]
+        offset = np.asarray(st.offset)
+        last = np.asarray(st.last)
+        need_snap = active & (nxt <= offset) & (offset > 0)
+        sendable = active & ~need_snap
+        prev_idx = np.where(sendable, nxt - 1, 0).astype(np.int32)
+        n_ents = np.where(
+            sendable, np.clip(last - prev_idx, 0, self.e),
+            0).astype(np.int32)
+        idx = prev_idx[:, None] + 1 + np.arange(self.e, dtype=np.int32)
+        # one device gather for prev terms + entry terms
+        terms2 = np.asarray(term_at(
+            st.log_term, st.offset, st.last,
+            jnp.asarray(np.concatenate(
+                [prev_idx[:, None], idx], axis=1))))
+        payloads = []
+        for gi in range(self.g):
+            row = []
+            for j in range(int(n_ents[gi])):
+                row.append(self.payloads[gi].get(
+                    int(prev_idx[gi]) + 1 + j, b""))
+            payloads.append(row)
+        return AppendBatch(
+            sender=self.slot, term=np.asarray(st.term),
+            prev_idx=prev_idx, prev_term=terms2[:, 0],
+            n_ents=n_ents, commit=np.asarray(st.commit),
+            active=active, need_snap=need_snap,
+            ent_terms=terms2[:, 1:], payloads=payloads)
+
+    def handle_append_resp(self, r: AppendResp) -> np.ndarray:
+        """Absorb a peer's batched response; returns the [G] commit
+        vector after quorum advance."""
+        before = np.asarray(self.state.commit)
+        self.state = _absorb_resp(
+            self.state, r.sender, jnp.asarray(r.term),
+            jnp.asarray(r.ok), jnp.asarray(r.acked),
+            jnp.asarray(r.hint), jnp.asarray(r.active))
+        return np.asarray(self.state.commit)
+
+    # -- follower path ----------------------------------------------------
+
+    def handle_append(self, b: AppendBatch) -> AppendResp:
+        """Batched msgApp receipt (stepFollower, raft.go:496-504):
+        adopt higher terms, maybe_append current-term lanes, store
+        payloads, reply with match/hint arrays.  The CALLER persists
+        the accepted entries BEFORE shipping the response."""
+        st = self.state
+        active = jnp.asarray(b.active)
+        term = jnp.asarray(b.term)
+        st = _adopt_term(st, term, jnp.full((self.g,), b.sender,
+                                            jnp.int32), active)
+        # equal-term appends also establish leadership + reset timer
+        cur = active & (term == st.term)
+        st = st._replace(
+            role=jnp.where(cur, FOLLOWER, st.role),
+            lead=jnp.where(cur, b.sender, st.lead),
+            elapsed=jnp.where(cur, 0, st.elapsed))
+        do = cur & ~jnp.asarray(b.need_snap)
+        st, ok, e_conf, e_over = maybe_append(
+            st, jnp.asarray(b.prev_idx), jnp.asarray(b.prev_term),
+            jnp.asarray(b.ent_terms), jnp.asarray(b.n_ents),
+            jnp.asarray(b.commit), active=do)
+        self.state = st
+        self.errors["conflict"] = np.asarray(e_conf)
+        self.errors["overflow"] = (self.errors["overflow"]
+                                   | np.asarray(e_over))
+        ok_np = np.asarray(ok)
+        for gi in np.nonzero(ok_np)[0]:
+            for j in range(int(b.n_ents[gi])):
+                self.payloads[gi][int(b.prev_idx[gi]) + 1 + j] = \
+                    b.payloads[gi][j]
+        return AppendResp(
+            sender=self.slot, term=np.asarray(st.term), ok=ok_np,
+            acked=(b.prev_idx + b.n_ents).astype(np.int32),
+            hint=np.asarray(st.commit),
+            active=np.asarray(cur) | (np.asarray(b.need_snap)
+                                      & np.asarray(active)))
+
+    def install_snapshot(self, frontier: np.ndarray,
+                         terms: np.ndarray,
+                         members: np.ndarray | None = None
+                         ) -> np.ndarray:
+        """Collapse lanes to a pulled snapshot's frontier
+        (raft.go:535-554 batched); returns installed lanes."""
+        st, installed = restore_snapshot(
+            self.state, jnp.asarray(frontier, jnp.int32),
+            jnp.asarray(terms, jnp.int32),
+            members=None if members is None else jnp.asarray(members))
+        self.state = st
+        inst = np.asarray(installed)
+        for gi in np.nonzero(inst)[0]:
+            cut = int(frontier[gi])
+            p = self.payloads[gi]
+            if p and min(p) <= cut:
+                self.payloads[gi] = {k: v for k, v in p.items()
+                                     if k > cut}
+        return inst
+
+    # -- elections --------------------------------------------------------
+
+    def begin_campaign(self, mask: np.ndarray) -> VoteReq:
+        """Start campaigns on the masked lanes; the returned frame
+        goes to every peer.  Caller persists the ballot (term+vote)
+        BEFORE shipping (vote durability, wal.go:35-39's state
+        record)."""
+        st, mj, lterm = _begin_campaign(
+            self.state, jnp.asarray(np.asarray(mask, bool)),
+            slot=self.slot)
+        self.state = st
+        return VoteReq(sender=self.slot, term=np.asarray(st.term),
+                       last=np.asarray(st.last),
+                       lterm=np.asarray(lterm),
+                       active=np.asarray(mj))
+
+    def handle_vote(self, v: VoteReq) -> VoteResp:
+        """Batched msgVote receipt (raft.go:511-518): adopt higher
+        terms, grant where log-up-to-date and not already voted.
+        Caller persists the ballot before shipping the response."""
+        st = self.state
+        active = jnp.asarray(v.active)
+        st = _adopt_term(st, jnp.asarray(v.term),
+                         jnp.full((self.g,), -1, jnp.int32), active)
+        st, granted = grant_vote(
+            st, jnp.asarray(v.last), jnp.asarray(v.lterm),
+            jnp.asarray(v.term),
+            jnp.full((self.g,), v.sender, jnp.int32), active=active)
+        st = st._replace(elapsed=jnp.where(granted, 0, st.elapsed))
+        self.state = st
+        return VoteResp(sender=self.slot, term=np.asarray(st.term),
+                        granted=np.asarray(granted),
+                        active=np.asarray(active))
+
+    def tally(self, mask: np.ndarray,
+              resps: list[VoteResp]) -> np.ndarray:
+        """Count votes (self + granted responses) for the campaign
+        lanes; quorum from live member counts.  Returns won lanes
+        (already promoted to leader)."""
+        votes = np.asarray(mask, np.int32).copy()  # own vote
+        st = self.state
+        for r in resps:
+            st = _adopt_term(st, jnp.asarray(r.term),
+                             jnp.full((self.g,), -1, jnp.int32),
+                             jnp.asarray(r.active))
+            votes += (r.granted & r.active).astype(np.int32)
+        quorum = np.asarray(st.nmembers) // 2 + 1
+        still_cand = np.asarray(st.role) == CANDIDATE
+        won = np.asarray(mask, bool) & still_cand & (votes >= quorum)
+        self.state = _become_leader(st, jnp.asarray(won),
+                                    slot=self.slot)
+        if won.any():
+            # Raft safety: uncommitted tail payloads beyond our last
+            # may be overwritten by the new term — drop stale keys
+            last = np.asarray(self.state.last)
+            for gi in np.nonzero(won)[0]:
+                p = self.payloads[gi]
+                if p and max(p) > int(last[gi]):
+                    self.payloads[gi] = {
+                        k: v for k, v in p.items()
+                        if k <= int(last[gi])}
+        return won
+
+    # -- timers / maintenance --------------------------------------------
+
+    def tick(self) -> np.ndarray:
+        """Advance timers; returns lanes whose election timer fired
+        (caller runs the campaign round-trip)."""
+        st, elect, _beat = tick_batch(self.state)
+        self.state = st
+        return np.asarray(elect)
+
+    def mark_applied(self, upto: np.ndarray) -> None:
+        st = self.state
+        upto = jnp.asarray(upto, jnp.int32)
+        self.state = st._replace(applied=jnp.maximum(
+            st.applied, jnp.minimum(upto, st.commit)))
+
+    def compact(self) -> None:
+        st = self.state
+        st, _err = compact_batch(st, jnp.maximum(st.applied,
+                                                 st.offset))
+        self.state = st
+        cut = np.asarray(st.offset)
+        for gi in range(self.g):
+            p = self.payloads[gi]
+            c = int(cut[gi])
+            if p and min(p) < c:
+                self.payloads[gi] = {k: v for k, v in p.items()
+                                     if k >= c}
+
+    def apply_conf_change(self, add: bool, slot: int,
+                          mask: np.ndarray | None = None) -> None:
+        """Adopt a COMMITTED membership change (server layer proposes
+        it through the log first, server.go:542-559)."""
+        mask = np.ones(self.g, bool) if mask is None \
+            else np.asarray(mask, bool)
+        self.state = conf_change_batch(
+            self.state, jnp.full((self.g,), bool(add)),
+            jnp.full((self.g,), slot, jnp.int32),
+            jnp.full((self.g,), self.slot, jnp.int32),
+            active=jnp.asarray(mask))

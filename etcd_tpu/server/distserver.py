@@ -1,0 +1,829 @@
+"""Distributed multi-group server: G co-hosted raft groups replicated
+across M HOSTS (one member slot per host) — SURVEY §5.8's two tiers
+composed.
+
+`MultiGroupServer` (multigroup.py) batches all M members in one
+process and therefore shares process fate; THIS server is the
+cross-host form the reference actually provides (a machine can die
+and the cluster keeps serving, etcdserver/cluster_store.go:106-156):
+
+- Each host runs ONE member slot of every group
+  (raft/distmember.py — the same batched device ops as the fused
+  runtime, applied to a single slot's [G] state).
+- A replication round ships ONE binary frame per peer host
+  (wire/distmsg.py: [G] prev_idx/prev_term/n_ents arrays + payload
+  blobs) over HTTP POST — the reference's fire-and-forget peer
+  transport (server.go:202-206) with the group axis batched.  A
+  failed POST is a dropped message; progress resumes next round.
+- Each host has its OWN WAL and snapshot dir: entries, ballots
+  (term/vote — double-vote safety across restarts) and commit
+  frontiers are fsynced before any response or ack leaves the host
+  (the Ready contract, node.go:41-60).
+- Slow or restarted followers catch up by normal append repair
+  (reject → next_ = commit hint + 1) or, past the leader's
+  compaction point, by pulling a full snapshot
+  (GET /mraft/snapshot — the msgSnap analog as a pull).
+
+Client writes go to the group's leader host (followers forward via
+POST /mraft/propose); reads serve from any host's store replica.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import queue
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlparse
+
+import numpy as np
+
+from ..raft.distmember import DistMember
+from ..snap import NoSnapshotError, Snapshotter
+from ..store import Store
+from ..utils.trace import tracer
+from ..utils.wait import Wait
+from ..wal import WAL, exist as wal_exist
+from ..wire import Entry, GroupEntry, HardState, Snapshot
+from ..wire.distmsg import (
+    KIND_APPEND,
+    KIND_VOTE,
+    AppendBatch,
+    AppendResp,
+    VoteReq,
+    VoteResp,
+    unmarshal_any,
+)
+from ..wire.requests import Info, Request
+from .multigroup import TICK_INTERVAL, group_of
+from .server import (
+    DEFAULT_SNAP_COUNT,
+    Response,
+    ServerStoppedError,
+    UnknownMethodError,
+    _replay_wal,
+    apply_request_to_store,
+)
+
+log = logging.getLogger(__name__)
+
+# WAL record kinds (GroupEntry.kind)
+K_ENTRY = 0      # a group's log entry
+K_FRONTIER = 1   # commit-frontier marker: [G] commit + [G] terms
+K_BALLOT = 2     # durable term/vote: [G] terms + [G] votes
+
+
+class _Pending:
+    __slots__ = ("req", "data", "id", "retries")
+
+    def __init__(self, req, data, id):
+        self.req, self.data, self.id = req, data, id
+        self.retries = 0
+
+
+class DistServer:
+    """Member ``slot`` of an M-host distributed multi-group cluster.
+
+    ``peer_urls``: slot-indexed peer base URLs (this host's own slot
+    entry is ignored); e.g. ``["http://127.0.0.1:7700", ...]``.
+    """
+
+    def __init__(self, data_dir: str, *, slot: int,
+                 peer_urls: list[str], g: int = 64,
+                 cap: int = 1024, name: str | None = None,
+                 snap_count: int = DEFAULT_SNAP_COUNT,
+                 max_batch_ents: int = 32,
+                 tick_interval: float = TICK_INTERVAL,
+                 sync_interval: float = 0.5,
+                 post_timeout: float = 1.0,
+                 election: int = 10,
+                 storage_backend: str = "auto"):
+        self.slot = slot
+        self.g, self.m = g, len(peer_urls)
+        self.peer_urls = list(peer_urls)
+        self.name = name or f"dist{slot}"
+        self.snap_count = snap_count or DEFAULT_SNAP_COUNT
+        self.tick_interval = tick_interval
+        self.sync_interval = sync_interval
+        self.post_timeout = post_timeout
+        self.backend = storage_backend
+        self.id = int.from_bytes(
+            hashlib.sha1(self.name.encode()).digest()[:8],
+            "big") & (2**63 - 1)
+
+        self.store = Store()
+        self.w = Wait()
+        self.done = threading.Event()
+        self.lock = threading.RLock()
+        self._queue: queue.Queue[_Pending | None] = queue.Queue()
+        self._requeue: list[deque] = [deque() for _ in range(g)]
+        self._need_pull = False      # snapshot catch-up requested
+        self._thread: threading.Thread | None = None
+        self._httpd = None
+
+        os.makedirs(data_dir, mode=0o700, exist_ok=True)
+        self._snapdir = os.path.join(data_dir, "snap")
+        os.makedirs(self._snapdir, mode=0o700, exist_ok=True)
+        self._waldir = os.path.join(data_dir, "wal")
+        crc_fn = None
+        if storage_backend != "host":
+            try:
+                from ..ops.crc_kernel import auto_crc32c
+
+                crc_fn = auto_crc32c
+            except ImportError:
+                pass
+        self.ss = Snapshotter(self._snapdir, crc_fn=crc_fn)
+
+        self.seq = 0
+        self.applied = np.zeros(g, np.int64)
+        self.raft_index = 0
+        self.raft_term = 0
+        self._snapi = 0
+        self._ballot = (np.zeros(g, np.int32), np.full(g, -1, np.int32))
+
+        self.mr = DistMember(g, self.m, slot, cap,
+                             election=election,
+                             max_batch_ents=max_batch_ents, seed=slot)
+        if wal_exist(self._waldir):
+            self._restart()
+        else:
+            self.wal = WAL.create(self._waldir,
+                                  Info(id=self.id).marshal())
+            zero = np.zeros(g, np.int32).tobytes()
+            self.wal.save(HardState(), [Entry(
+                index=0, term=0,
+                data=GroupEntry(kind=K_FRONTIER,
+                                payload=zero + zero).marshal())])
+
+    # -- restart ----------------------------------------------------------
+
+    def _restart(self) -> None:
+        """Snapshot + WAL replay → store, frontier, AND the log tail.
+
+        Unlike the fate-sharing co-hosted server (which may drop
+        never-acked tails, multigroup.py:26-31), a distributed member
+        MUST retain entries it acked to the leader even if they are
+        not yet committed — the leader counts that ack toward quorum
+        (Raft durability).  So the tail above the frontier is
+        reconstructed into the engine log, and the persisted ballot
+        (term/vote) is restored for double-vote safety.
+        """
+        g = self.g
+        frontier = np.zeros(g, np.int64)
+        fterms = np.zeros(g, np.int64)
+        snap_index = 0
+        applied_total = 0
+        try:
+            snap = self.ss.load()
+        except NoSnapshotError:
+            snap = None
+        if snap is not None:
+            blob = json.loads(snap.data.decode())
+            if len(blob["frontier"]) != g:
+                raise RuntimeError(
+                    f"snapshot written with g={len(blob['frontier'])}"
+                    f", not {g}")
+            self.store.recovery(blob["store"].encode())
+            frontier = np.asarray(blob["frontier"], np.int64)
+            fterms = np.asarray(blob["terms"], np.int64)
+            snap_index = blob["seq"]
+            applied_total = blob.get("applied_total", 0)
+        snap_frontier = frontier.copy()
+        self.seq = snap_index
+
+        self.wal, md, _hs, ents = _replay_wal(
+            self._waldir, snap_index, self.backend)
+        info = Info.unmarshal(md or b"")
+        if info.id != self.id:
+            raise RuntimeError(
+                f"unexpected server id {info.id:x}, want {self.id:x}")
+
+        winners: dict[tuple[int, int], GroupEntry] = {}
+        terms = np.zeros(g, np.int32)
+        votes = np.full(g, -1, np.int32)
+        for e in ents:
+            ge = GroupEntry.unmarshal(e.data)
+            if ge.kind == K_ENTRY:
+                winners[(ge.group, ge.gindex)] = ge
+            elif ge.kind == K_FRONTIER:
+                v = np.frombuffer(ge.payload, np.int32)
+                if v.size != 2 * g:
+                    raise RuntimeError(
+                        f"data dir written with g={v.size // 2}, "
+                        f"not {g}")
+                # frontier records are monotonic in stream order:
+                # the last one wins (newer than the snapshot too)
+                frontier = v[:g].astype(np.int64)
+                fterms = v[g:].astype(np.int64)
+            elif ge.kind == K_BALLOT:
+                v = np.frombuffer(ge.payload, np.int32)
+                terms = v[:g].copy()
+                votes = v[g:2 * g].copy()
+            self.seq = max(self.seq, e.index)
+
+        # committed prefix → store (stream order by (group, gindex))
+        applied_n = 0
+        for (gi, idx) in sorted(winners.keys()):
+            if not (snap_frontier[gi] < idx <= frontier[gi]):
+                continue
+            ge = winners[(gi, idx)]
+            if ge.payload:
+                r = Request.unmarshal(ge.payload)
+                apply_request_to_store(self.store, r)
+            applied_n += 1
+
+        # engine seeding: compacted-at-frontier log + contiguous tail
+        mr = self.mr
+        import jax.numpy as jnp
+
+        last = frontier.copy()
+        cap = mr.cap
+        log_term = np.zeros((g, cap), np.int32)
+        for gi in range(g):
+            log_term[gi, 0] = fterms[gi]
+            idx = int(frontier[gi]) + 1
+            while (gi, idx) in winners and idx - frontier[gi] < cap:
+                ge = winners[(gi, idx)]
+                log_term[gi, idx - int(frontier[gi])] = ge.gterm
+                if ge.payload:
+                    mr.payloads[gi][idx] = ge.payload
+                idx += 1
+            last[gi] = idx - 1
+        terms = np.maximum(terms, fterms.astype(np.int32))
+        fr = jnp.asarray(frontier, jnp.int32)
+        st = mr.state._replace(
+            term=jnp.asarray(terms), vote=jnp.asarray(votes),
+            commit=fr, applied=fr, offset=fr,
+            last=jnp.asarray(last, jnp.int32),
+            log_term=jnp.asarray(log_term))
+        mr.state = st
+        self._ballot = (terms.copy(), votes.copy())
+        self.applied = frontier.copy()
+        self.raft_index = applied_total + applied_n
+        self.raft_term = int(terms.max()) if g else 0
+        self._snapi = self.raft_index
+        log.info("dist[%d]: restart — %d replayed, %d applied, "
+                 "tail up to %s", self.slot, len(ents), applied_n,
+                 int(last.max()) if g else 0)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        """Bind the peer listener and start the round loop."""
+        u = urlparse(self.peer_urls[self.slot])
+        handler = _make_peer_handler(self)
+        self._httpd = ThreadingHTTPServer((u.hostname, u.port),
+                                          handler)
+        self._httpd.daemon_threads = True
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.done.set()
+        self._queue.put(None)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()  # release the port for rebinds
+        if self._thread is not None \
+                and self._thread is not threading.current_thread():
+            self._thread.join(timeout=10)
+        with self.lock:
+            self.wal.close()
+
+    # -- durability helpers (call with self.lock held) --------------------
+
+    def _persist(self, ents: list[Entry],
+                 frontier: bool = True) -> None:
+        """WAL-append ``ents`` (+ a frontier marker) and fsync."""
+        if frontier:
+            commit = self.mr.commit_index().astype(np.int32)
+            terms = self.mr.commit_terms().astype(np.int32)
+            self.seq += 1
+            ents = ents + [Entry(
+                index=self.seq, term=self.raft_term,
+                data=GroupEntry(
+                    kind=K_FRONTIER,
+                    payload=commit.tobytes() + terms.tobytes())
+                .marshal())]
+        self.wal.save(HardState(term=self.raft_term, vote=0,
+                                commit=self.seq), ents)
+
+    def _persist_ballot(self) -> None:
+        """Durable term/vote BEFORE any vote or campaign leaves this
+        host (the HardState analog, wal.go:35-39) — only when it
+        actually changed."""
+        st = self.mr.state
+        terms = np.asarray(st.term, np.int32)
+        votes = np.asarray(st.vote, np.int32)
+        if (np.array_equal(terms, self._ballot[0])
+                and np.array_equal(votes, self._ballot[1])):
+            return
+        self._ballot = (terms.copy(), votes.copy())
+        self.raft_term = max(self.raft_term, int(terms.max()))
+        self.seq += 1
+        self.wal.save(
+            HardState(term=self.raft_term, vote=0, commit=self.seq),
+            [Entry(index=self.seq, term=self.raft_term,
+                   data=GroupEntry(
+                       kind=K_BALLOT,
+                       payload=terms.tobytes() + votes.tobytes())
+                   .marshal())])
+
+    def _entry_records(self, gis, base, items) -> list[Entry]:
+        """WAL records for entries appended at this host."""
+        terms = self.mr.terms()
+        out = []
+        for gi in gis:
+            for j, p in enumerate(items[gi]):
+                self.seq += 1
+                out.append(Entry(
+                    index=self.seq, term=self.raft_term,
+                    data=GroupEntry(
+                        kind=K_ENTRY, group=int(gi),
+                        gindex=int(base[gi]) + 1 + j,
+                        gterm=int(terms[gi]),
+                        payload=p.data).marshal()))
+        return out
+
+    # -- peer RPC (HTTP handler entry points) -----------------------------
+
+    def handle_frame(self, data: bytes) -> bytes:
+        """POST /mraft: one batched consensus frame in, the response
+        frame out.  Everything this host learned is durable before
+        the response bytes leave (Ready contract ordering)."""
+        msg = unmarshal_any(data)
+        with self.lock:
+            if isinstance(msg, AppendBatch):
+                resp = self.mr.handle_append(msg)
+                recs = []
+                ok = resp.ok
+                terms = self.mr.terms()
+                for gi in np.nonzero(ok)[0]:
+                    for j in range(int(msg.n_ents[gi])):
+                        self.seq += 1
+                        recs.append(Entry(
+                            index=self.seq, term=self.raft_term,
+                            data=GroupEntry(
+                                kind=K_ENTRY, group=int(gi),
+                                gindex=int(msg.prev_idx[gi]) + 1 + j,
+                                gterm=int(msg.ent_terms[gi, j]),
+                                payload=msg.payloads[gi][j])
+                            .marshal()))
+                self._persist_ballot()
+                self._persist(recs)
+                if bool(np.any(msg.need_snap & msg.active)):
+                    self._need_pull = True
+                self._apply_committed()
+                return resp.marshal()
+            if isinstance(msg, VoteReq):
+                resp = self.mr.handle_vote(msg)
+                self._persist_ballot()
+                return resp.marshal()
+        raise ValueError(f"unhandled frame {type(msg).__name__}")
+
+    def handle_forward(self, data: bytes,
+                       timeout: float) -> Response:
+        """POST /mraft/propose: a follower-forwarded client write."""
+        r = Request.unmarshal(data)
+        return self.do(r, timeout=timeout, forward=False)
+
+    def snapshot_blob(self) -> bytes:
+        """GET /mraft/snapshot: the current store + frontier (what a
+        lagging follower installs)."""
+        with self.lock:
+            return json.dumps({
+                "store": self.store.save().decode(),
+                "frontier": [int(x) for x in self.applied],
+                "terms": [int(x) for x in
+                          self.mr.terms_at(self.applied).astype(int)],
+                "seq": self.seq,
+                "applied_total": self.raft_index,
+            }).encode()
+
+    # -- client path ------------------------------------------------------
+
+    def do(self, r: Request, timeout: float | None = None,
+           forward: bool = True) -> Response:
+        """Reference Do() semantics (server.go:337-380): writes and
+        quorum reads through the group's consensus (forwarded to the
+        leader host when that is not us); plain reads and watches
+        from the local replica."""
+        if r.id == 0:
+            raise ValueError("r.id cannot be 0")
+        if r.method == "GET" and r.quorum:
+            r.method = "QGET"
+        if r.method in ("POST", "PUT", "DELETE", "QGET"):
+            gi = group_of(r.path, self.g)
+            data = r.marshal()
+            if not self.mr.is_leader()[gi]:
+                if not forward:
+                    raise TimeoutError("not leader (no re-forward)")
+                return self._forward(gi, data, timeout)
+            ch = self.w.register(r.id)
+            self._queue.put(_Pending(req=r, data=data, id=r.id))
+            try:
+                x = ch.get(timeout=timeout)
+            except queue.Empty:
+                self.w.trigger(r.id, None)
+                raise TimeoutError("request timed out")
+            if x is None:
+                if self.done.is_set():
+                    raise ServerStoppedError()
+                raise TimeoutError("request dropped (no leader)")
+            if x.err is not None:
+                raise x.err
+            return x
+        if r.method == "GET":
+            if r.wait:
+                wc = self.store.watch(r.path, r.recursive, r.stream,
+                                      r.since)
+                return Response(watcher=wc)
+            ev = self.store.get(r.path, r.recursive, r.sorted)
+            return Response(event=ev)
+        raise UnknownMethodError(r.method)
+
+    def _forward(self, gi: int, data: bytes,
+                 timeout: float | None) -> Response:
+        """Forward a write to the group's leader host and surface its
+        result as a store re-read (the event applied there reaches
+        our replica via replication; the authoritative response body
+        is re-served locally once our replica catches up)."""
+        lead = int(self.mr.leader_hint()[gi])
+        if lead < 0 or lead == self.slot:
+            raise TimeoutError("no leader for group")
+        url = self.peer_urls[lead] + "/mraft/propose"
+        req = urllib.request.Request(
+            url, data=data, method="POST",
+            headers={"Content-Type": "application/octet-stream"})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=timeout or 5.0) as resp:
+                body = resp.read()
+        except (urllib.error.URLError, OSError) as e:
+            raise TimeoutError(f"forward failed: {e}") from None
+        d = json.loads(body.decode())
+        if not d.get("ok"):
+            from ..utils.errors import EtcdError
+
+            raise EtcdError(d.get("errorCode", 300),
+                            d.get("message", "forwarded propose "
+                                             "failed"), d.get("cause"))
+        from ..store.event import Event
+
+        return Response(event=Event.from_dict(d["event"])
+                        if d.get("event") else None)
+
+    # -- the round loop ---------------------------------------------------
+
+    def run(self) -> None:
+        next_tick = time.monotonic() + self.tick_interval
+        next_sync = time.monotonic() + self.sync_interval
+        batch: list[_Pending] = []
+        while not self.done.is_set():
+            batch = self._drain(timeout=min(
+                self.tick_interval,
+                max(next_tick - time.monotonic(), 0.001)))
+            if self.done.is_set():
+                break
+            now = time.monotonic()
+            if now >= next_sync:
+                with self.lock:
+                    if self.mr.is_leader().any():
+                        self.store.delete_expired_keys(time.time())
+                next_sync = now + self.sync_interval
+            if now >= next_tick:
+                next_tick = now + self.tick_interval
+                with self.lock:
+                    fire = self.mr.tick()
+                    # a follower hearing appends has elapsed reset;
+                    # lanes that fire lost their leader
+                if fire.any():
+                    self._campaign(fire)
+            if self._need_pull:
+                self._need_pull = False
+                self._pull_snapshot()
+            self._leader_round(batch)
+
+        for p in batch:
+            self.w.trigger(p.id, None)
+        while True:
+            try:
+                p = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if p is not None:
+                self.w.trigger(p.id, None)
+        for q in self._requeue:
+            while q:
+                self.w.trigger(q.popleft().id, None)
+
+    def _drain(self, timeout: float) -> list[_Pending]:
+        out = []
+        try:
+            p = self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return out
+        if p is not None:
+            out.append(p)
+        while True:
+            try:
+                p = self._queue.get_nowait()
+            except queue.Empty:
+                return out
+            if p is not None:
+                out.append(p)
+
+    def _leader_round(self, batch: list[_Pending]) -> None:
+        """Drain → append → persist → replicate (one frame per peer)
+        → absorb → commit → apply → ack: the reference run() loop
+        (server.go:247-323) with the whole group batch per step."""
+        mr = self.mr
+        with self.lock:
+            lead = mr.is_leader()
+            n_new = np.zeros(self.g, np.int32)
+            items: list[list[_Pending]] = [[] for _ in range(self.g)]
+            for gi in range(self.g):
+                q = self._requeue[gi]
+                while q and len(items[gi]) < mr.e:
+                    items[gi].append(q.popleft())
+            for p in batch:
+                gi = group_of(p.req.path, self.g)
+                if not lead[gi] or len(items[gi]) >= mr.e:
+                    self._requeue[gi].append(p)
+                    continue
+                items[gi].append(p)
+            for gi in range(self.g):
+                n_new[gi] = len(items[gi])
+
+            assigned: dict[tuple[int, int], _Pending] = {}
+            if n_new.any():
+                valid, base = mr.propose(
+                    n_new, data=[[p.data for p in items[gi]]
+                                 for gi in range(self.g)])
+                recs = []
+                for gi in range(self.g):
+                    if not items[gi]:
+                        continue
+                    if not valid[gi]:
+                        for p in items[gi]:
+                            p.retries += 1
+                            if p.retries < 50:
+                                self._requeue[gi].append(p)
+                            else:
+                                self.w.trigger(p.id, None)
+                        continue
+                    for j, p in enumerate(items[gi]):
+                        assigned[(gi, int(base[gi]) + 1 + j)] = p
+                recs = self._entry_records(
+                    [gi for gi in range(self.g)
+                     if items[gi] and valid[gi]], base, items)
+                with tracer.span("dist.persist"):
+                    self._persist(recs)
+            elif not lead.any():
+                return
+
+            frames = []
+            for peer in range(self.m):
+                if peer == self.slot:
+                    continue
+                b = mr.build_append(peer)
+                if b is not None:
+                    frames.append((peer, b.marshal()))
+
+        # network I/O OUTSIDE the lock (a slow peer must not block
+        # the HTTP handlers) and in PARALLEL across peers — a serial
+        # scan would add peers' round-trips together and a slow peer
+        # would push round latency past follower election timeouts
+        # (leadership flapping); a failed POST is simply a dropped
+        # message pair
+        resps = self._exchange(frames)
+
+        with self.lock:
+            for r in resps:
+                if isinstance(r, AppendResp):
+                    mr.handle_append_resp(r)
+            self._persist([])          # frontier moved (maybe)
+            self._apply_committed(assigned)
+
+    def _campaign(self, mask: np.ndarray) -> None:
+        """Batched election round-trip for the fired lanes."""
+        with self.lock:
+            req = self.mr.begin_campaign(mask)
+            self._persist_ballot()
+            payload = req.marshal()
+        votes = [v for v in self._exchange(
+            [(p, payload) for p in range(self.m) if p != self.slot])
+            if isinstance(v, VoteResp)]
+        with self.lock:
+            won = self.mr.tally(req.active, votes)
+            self._persist_ballot()
+            if won.any():
+                log.info("dist[%d]: won %d groups", self.slot,
+                         int(won.sum()))
+                # becoming-leader empty entry (raft.go:329-348) —
+                # replicated and committed via the normal rounds
+                valid, base = self.mr.propose(
+                    won.astype(np.int32),
+                    data=[[b""] if won[gi] else []
+                          for gi in range(self.g)])
+                recs = []
+                terms = self.mr.terms()
+                for gi in np.nonzero(valid)[0]:
+                    self.seq += 1
+                    recs.append(Entry(
+                        index=self.seq, term=self.raft_term,
+                        data=GroupEntry(
+                            kind=K_ENTRY, group=int(gi),
+                            gindex=int(base[gi]) + 1,
+                            gterm=int(terms[gi])).marshal()))
+                self._persist(recs)
+
+    def _exchange(self, frames: list[tuple[int, bytes]]) -> list:
+        """POST one frame per peer concurrently; returns the parsed
+        responses that arrived (drops parse failures and dead peers).
+        """
+        if not frames:
+            return []
+        from concurrent.futures import ThreadPoolExecutor
+
+        def one(arg):
+            peer, payload = arg
+            out = self._post_peer(peer, "/mraft", payload)
+            if out is None:
+                return None
+            try:
+                return unmarshal_any(out)
+            except Exception:
+                return None
+
+        with ThreadPoolExecutor(len(frames)) as pool:
+            return [r for r in pool.map(one, frames)
+                    if r is not None]
+
+    def _post_peer(self, peer: int, path: str,
+                   payload: bytes) -> bytes | None:
+        req = urllib.request.Request(
+            self.peer_urls[peer] + path, data=payload, method="POST",
+            headers={"Content-Type": "application/octet-stream"})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.post_timeout) as resp:
+                return resp.read()
+        except (urllib.error.URLError, OSError, ConnectionError):
+            return None
+
+    # -- apply ------------------------------------------------------------
+
+    def _apply_committed(self, assigned=None) -> None:
+        """Apply newly committed entries to the local replica (call
+        with lock held); leader lanes also ack their waiters."""
+        mr = self.mr
+        commit = mr.commit_index().astype(np.int64)
+        newly = commit > self.applied
+        if not newly.any():
+            return
+        for gi in np.nonzero(newly)[0]:
+            for idx in range(int(self.applied[gi]) + 1,
+                             int(commit[gi]) + 1):
+                payload = mr.committed_payload(int(gi), idx)
+                resp = None
+                if payload:
+                    r = Request.unmarshal(payload)
+                    resp = apply_request_to_store(self.store, r)
+                self.raft_index += 1
+                p = (assigned or {}).pop((int(gi), idx), None)
+                if p is not None:
+                    self.w.trigger(p.id, resp)
+                elif payload:
+                    self.w.trigger(r.id, resp)
+            self.applied[gi] = commit[gi]
+        mr.mark_applied(self.applied)
+        if self.raft_index - self._snapi > self.snap_count:
+            self.snapshot()
+
+    # -- snapshot / catch-up ----------------------------------------------
+
+    def snapshot(self) -> None:
+        with tracer.span("dist.snapshot"):
+            self.ss.save_snap(Snapshot(
+                data=self.snapshot_blob(), index=self.seq,
+                term=self.raft_term))
+            self.mr.compact()
+            self.wal.cut()
+        self._snapi = self.raft_index
+        log.info("dist[%d]: snapshot at seq=%d", self.slot, self.seq)
+
+    def _pull_snapshot(self) -> None:
+        """Fetch + install the leader's snapshot (msgSnap-as-pull).
+
+        Installs only when the snapshot's frontier dominates our
+        applied vector — the store blob is the merged state of ALL
+        groups, so a partial install could regress groups that are
+        ahead; a uniformly-behind (fresh or restarted) member always
+        qualifies, which is the case the pull path exists for."""
+        lead = self.mr.leader_hint()
+        hosts = {int(s) for s in lead if s >= 0 and s != self.slot}
+        for h in sorted(hosts):
+            try:
+                with urllib.request.urlopen(
+                        self.peer_urls[h] + "/mraft/snapshot",
+                        timeout=self.post_timeout * 5) as resp:
+                    blob = json.loads(resp.read().decode())
+            except (urllib.error.URLError, OSError,
+                    ValueError):
+                continue
+            frontier = np.asarray(blob["frontier"], np.int64)
+            terms = np.asarray(blob["terms"], np.int64)
+            with self.lock:
+                if not (frontier >= self.applied).all():
+                    log.info("dist[%d]: snapshot from %d does not "
+                             "dominate; skipping", self.slot, h)
+                    continue
+                inst = self.mr.install_snapshot(frontier, terms)
+                if not inst.any():
+                    continue
+                self.store.recovery(blob["store"].encode())
+                self.applied = frontier.copy()
+                self.raft_index = blob.get("applied_total",
+                                           self.raft_index)
+                self.raft_term = max(self.raft_term,
+                                     int(terms.max()))
+                self._persist([])
+                log.info("dist[%d]: installed snapshot from host %d "
+                         "(%d lanes)", self.slot, h, int(inst.sum()))
+            return
+
+    # -- RaftTimer --------------------------------------------------------
+
+    def index(self) -> int:
+        return self.raft_index
+
+    def term(self) -> int:
+        return self.raft_term
+
+
+# -- peer HTTP plumbing -----------------------------------------------------
+
+
+def _make_peer_handler(server: DistServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _body(self) -> bytes:
+            n = int(self.headers.get("Content-Length", 0))
+            return self.rfile.read(n)
+
+        def do_POST(self):
+            try:
+                if self.path == "/mraft":
+                    out = server.handle_frame(self._body())
+                    self._reply(200, out)
+                elif self.path == "/mraft/propose":
+                    try:
+                        resp = server.handle_forward(
+                            self._body(), timeout=5.0)
+                        ev = resp.event.to_dict() \
+                            if resp.event is not None else None
+                        self._reply(200, json.dumps(
+                            {"ok": True, "event": ev}).encode())
+                    except Exception as e:
+                        code = getattr(e, "error_code", 300)
+                        self._reply(200, json.dumps(
+                            {"ok": False, "errorCode": code,
+                             "message": str(e)}).encode())
+                else:
+                    self._reply(404, b"")
+            except Exception:
+                log.exception("peer handler failed")
+                try:
+                    self._reply(500, b"")
+                except Exception:
+                    pass
+
+        def do_GET(self):
+            if self.path == "/mraft/snapshot":
+                self._reply(200, server.snapshot_blob())
+            else:
+                self._reply(404, b"")
+
+        def _reply(self, code: int, body: bytes) -> None:
+            self.send_response(code)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if body:
+                self.wfile.write(body)
+
+    return Handler

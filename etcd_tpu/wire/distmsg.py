@@ -1,0 +1,272 @@
+"""Batched inter-host consensus frames for the distributed
+multi-group server (SURVEY §5.8's DCN tier).
+
+The reference's peer transport ships ONE raftpb.Message per HTTP POST
+(etcdserver/cluster_store.go:106-156).  The distributed multi-group
+server hosts one member slot of ALL G co-hosted groups per process,
+so a replication round produces G messages *per peer* — shipped here
+as ONE binary frame of [G] arrays (the batched analog: same
+fire-and-forget, drop-tolerant contract, server.go:202-206, but the
+unit of transport is the whole group batch).
+
+Frame = 16-byte header + fixed [G] sections + payload table:
+
+  header:  magic "DGB1" | kind u8 | sender_slot u8 | flags u16 |
+           g u32 | e u32
+  body:    kind-specific little-endian arrays (see each class)
+  payload: lens [sum(n_ents)] i32 + concatenated blobs (appends only)
+
+Arrays are raw numpy little-endian — the receiving end feeds them
+straight into the batched engine (raft/batched.py) without a decode
+loop: wire layout == device layout is the point.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+import numpy as np
+
+_MAGIC = b"DGB1"
+_HDR = struct.Struct("<4sBBHII")
+
+KIND_APPEND = 0
+KIND_APPEND_RESP = 1
+KIND_VOTE = 2
+KIND_VOTE_RESP = 3
+KIND_PROPOSE = 4
+
+
+class FrameError(Exception):
+    pass
+
+
+def _i32(g: int, buf: memoryview, pos: int) -> tuple[np.ndarray, int]:
+    end = pos + 4 * g
+    if end > len(buf):
+        raise FrameError("truncated i32 section")
+    return np.frombuffer(buf[pos:end], "<i4").copy(), end
+
+
+def _u8(g: int, buf: memoryview, pos: int) -> tuple[np.ndarray, int]:
+    end = pos + g
+    if end > len(buf):
+        raise FrameError("truncated u8 section")
+    return np.frombuffer(buf[pos:end], np.uint8).copy(), end
+
+
+def _header(kind: int, sender: int, g: int, e: int = 0) -> bytes:
+    return _HDR.pack(_MAGIC, kind, sender, 0, g, e)
+
+
+def parse_header(data: bytes) -> tuple[int, int, int, int]:
+    """Returns (kind, sender_slot, g, e); raises FrameError."""
+    if len(data) < _HDR.size:
+        raise FrameError("short frame")
+    magic, kind, sender, _flags, g, e = _HDR.unpack_from(data)
+    if magic != _MAGIC:
+        raise FrameError("bad magic")
+    return kind, sender, g, e
+
+
+@dataclass
+class AppendBatch:
+    """Leader → follower replication round for all G groups at once
+    (the batched msgApp, raft.proto:31-42 fields term/index/logTerm/
+    entries/commit, G-wide).
+
+    ``active[g]``: this frame carries an append for group g.
+    ``need_snap[g]``: the leader has compacted past the follower's
+    next index — follower must pull a full snapshot (the msgSnap
+    analog, raft.go:207-209, as a pull to keep round frames small).
+    ``ent_terms[g, j]``: term of entry prev_idx[g]+1+j, j < n_ents[g].
+    ``payloads[g][j]``: that entry's opaque payload bytes.
+    """
+
+    sender: int
+    term: np.ndarray        # [G] i32 leader term
+    prev_idx: np.ndarray    # [G] i32
+    prev_term: np.ndarray   # [G] i32
+    n_ents: np.ndarray      # [G] i32
+    commit: np.ndarray      # [G] i32 leader commit
+    active: np.ndarray      # [G] bool
+    need_snap: np.ndarray   # [G] bool
+    ent_terms: np.ndarray   # [G, E] i32
+    payloads: list[list[bytes]] = field(default_factory=list)
+
+    def marshal(self) -> bytes:
+        g = self.term.shape[0]
+        e = self.ent_terms.shape[1] if self.ent_terms.size else 0
+        lens, blobs = [], []
+        for gi in range(g):
+            row = self.payloads[gi] if self.payloads else []
+            for j in range(int(self.n_ents[gi])):
+                b = row[j] if j < len(row) else b""
+                lens.append(len(b))
+                blobs.append(b)
+        return b"".join([
+            _header(KIND_APPEND, self.sender, g, e),
+            np.asarray(self.term, "<i4").tobytes(),
+            np.asarray(self.prev_idx, "<i4").tobytes(),
+            np.asarray(self.prev_term, "<i4").tobytes(),
+            np.asarray(self.n_ents, "<i4").tobytes(),
+            np.asarray(self.commit, "<i4").tobytes(),
+            np.asarray(self.active, np.uint8).tobytes(),
+            np.asarray(self.need_snap, np.uint8).tobytes(),
+            np.ascontiguousarray(self.ent_terms, "<i4").tobytes(),
+            np.asarray(lens, "<i4").tobytes(),
+        ] + blobs)
+
+    @classmethod
+    def unmarshal(cls, data: bytes) -> "AppendBatch":
+        kind, sender, g, e = parse_header(data)
+        if kind != KIND_APPEND:
+            raise FrameError(f"kind {kind} != append")
+        buf = memoryview(data)
+        pos = _HDR.size
+        term, pos = _i32(g, buf, pos)
+        prev_idx, pos = _i32(g, buf, pos)
+        prev_term, pos = _i32(g, buf, pos)
+        n_ents, pos = _i32(g, buf, pos)
+        commit, pos = _i32(g, buf, pos)
+        active, pos = _u8(g, buf, pos)
+        need_snap, pos = _u8(g, buf, pos)
+        ets, pos = _i32(g * e, buf, pos)
+        total = int(n_ents.sum())
+        lens, pos = _i32(total, buf, pos)
+        payloads: list[list[bytes]] = []
+        li = 0
+        for gi in range(g):
+            row = []
+            for _ in range(int(n_ents[gi])):
+                ln = int(lens[li])
+                li += 1
+                row.append(bytes(buf[pos:pos + ln]))
+                pos += ln
+            payloads.append(row)
+        return cls(sender=sender, term=term, prev_idx=prev_idx,
+                   prev_term=prev_term, n_ents=n_ents, commit=commit,
+                   active=active.astype(bool),
+                   need_snap=need_snap.astype(bool),
+                   ent_terms=ets.reshape(g, e), payloads=payloads)
+
+
+@dataclass
+class AppendResp:
+    """Follower → leader batched msgAppResp.
+
+    ``acked[g]``: on success, the follower's new match index; on
+    reject, ignored.  ``hint[g]``: the follower's commit index — the
+    leader repairs next_ to hint+1 on reject (faster than the
+    reference's decrement-by-one probe, raft.go:464-470; safe because
+    the committed prefix always matches)."""
+
+    sender: int
+    term: np.ndarray    # [G] i32 follower term (leader steps down if >)
+    ok: np.ndarray      # [G] bool
+    acked: np.ndarray   # [G] i32
+    hint: np.ndarray    # [G] i32
+    active: np.ndarray  # [G] bool
+
+    def marshal(self) -> bytes:
+        g = self.term.shape[0]
+        return b"".join([
+            _header(KIND_APPEND_RESP, self.sender, g),
+            np.asarray(self.term, "<i4").tobytes(),
+            np.asarray(self.ok, np.uint8).tobytes(),
+            np.asarray(self.acked, "<i4").tobytes(),
+            np.asarray(self.hint, "<i4").tobytes(),
+            np.asarray(self.active, np.uint8).tobytes(),
+        ])
+
+    @classmethod
+    def unmarshal(cls, data: bytes) -> "AppendResp":
+        kind, sender, g, _ = parse_header(data)
+        if kind != KIND_APPEND_RESP:
+            raise FrameError(f"kind {kind} != append_resp")
+        buf = memoryview(data)
+        pos = _HDR.size
+        term, pos = _i32(g, buf, pos)
+        ok, pos = _u8(g, buf, pos)
+        acked, pos = _i32(g, buf, pos)
+        hint, pos = _i32(g, buf, pos)
+        active, pos = _u8(g, buf, pos)
+        return cls(sender=sender, term=term, ok=ok.astype(bool),
+                   acked=acked, hint=hint, active=active.astype(bool))
+
+
+@dataclass
+class VoteReq:
+    """Candidate → peer batched msgVote (raft.go:363-369)."""
+
+    sender: int
+    term: np.ndarray    # [G] i32 candidate term
+    last: np.ndarray    # [G] i32 candidate last index
+    lterm: np.ndarray   # [G] i32 candidate last term
+    active: np.ndarray  # [G] bool
+
+    def marshal(self) -> bytes:
+        g = self.term.shape[0]
+        return b"".join([
+            _header(KIND_VOTE, self.sender, g),
+            np.asarray(self.term, "<i4").tobytes(),
+            np.asarray(self.last, "<i4").tobytes(),
+            np.asarray(self.lterm, "<i4").tobytes(),
+            np.asarray(self.active, np.uint8).tobytes(),
+        ])
+
+    @classmethod
+    def unmarshal(cls, data: bytes) -> "VoteReq":
+        kind, sender, g, _ = parse_header(data)
+        if kind != KIND_VOTE:
+            raise FrameError(f"kind {kind} != vote")
+        buf = memoryview(data)
+        pos = _HDR.size
+        term, pos = _i32(g, buf, pos)
+        last, pos = _i32(g, buf, pos)
+        lterm, pos = _i32(g, buf, pos)
+        active, pos = _u8(g, buf, pos)
+        return cls(sender=sender, term=term, last=last, lterm=lterm,
+                   active=active.astype(bool))
+
+
+@dataclass
+class VoteResp:
+    """Peer → candidate batched msgVoteResp."""
+
+    sender: int
+    term: np.ndarray     # [G] i32 responder term
+    granted: np.ndarray  # [G] bool
+    active: np.ndarray   # [G] bool
+
+    def marshal(self) -> bytes:
+        g = self.term.shape[0]
+        return b"".join([
+            _header(KIND_VOTE_RESP, self.sender, g),
+            np.asarray(self.term, "<i4").tobytes(),
+            np.asarray(self.granted, np.uint8).tobytes(),
+            np.asarray(self.active, np.uint8).tobytes(),
+        ])
+
+    @classmethod
+    def unmarshal(cls, data: bytes) -> "VoteResp":
+        kind, sender, g, _ = parse_header(data)
+        if kind != KIND_VOTE_RESP:
+            raise FrameError(f"kind {kind} != vote_resp")
+        buf = memoryview(data)
+        pos = _HDR.size
+        term, pos = _i32(g, buf, pos)
+        granted, pos = _u8(g, buf, pos)
+        active, pos = _u8(g, buf, pos)
+        return cls(sender=sender, term=term,
+                   granted=granted.astype(bool),
+                   active=active.astype(bool))
+
+
+def unmarshal_any(data: bytes):
+    kind, *_ = parse_header(data)
+    return {KIND_APPEND: AppendBatch,
+            KIND_APPEND_RESP: AppendResp,
+            KIND_VOTE: VoteReq,
+            KIND_VOTE_RESP: VoteResp}[kind].unmarshal(data)

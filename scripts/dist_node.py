@@ -1,0 +1,69 @@
+"""Standalone distributed-multigroup node (one member slot per
+process) — the runner behind the kill -9 integration test and
+`scripts/dist-cluster`.
+
+Usage:
+  python scripts/dist_node.py --data-dir D --slot N \
+      --peers http://127.0.0.1:7700,http://127.0.0.1:7701,... \
+      [--groups 8] [--bootstrap]
+
+Prints "READY" once serving (and, with --bootstrap, once this node
+leads every group).  Writes arrive via POST /mraft/propose (a
+marshaled wire Request); peers exchange batched frames on /mraft.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# mirror tests/conftest.py: the pure CPU backend, forced after import
+# (the tunnel plugin overrides env-only selection)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from etcd_tpu.server.distserver import DistServer  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", required=True)
+    ap.add_argument("--slot", type=int, required=True)
+    ap.add_argument("--peers", required=True,
+                    help="comma-separated slot-indexed base URLs")
+    ap.add_argument("--groups", type=int, default=8)
+    ap.add_argument("--cap", type=int, default=64)
+    ap.add_argument("--bootstrap", action="store_true",
+                    help="campaign for every group before READY")
+    args = ap.parse_args()
+
+    srv = DistServer(args.data_dir, slot=args.slot,
+                     peer_urls=args.peers.split(","),
+                     g=args.groups, cap=args.cap,
+                     tick_interval=0.05, post_timeout=2.0,
+                     election=60)
+    srv.start()
+    if args.bootstrap:
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            lead = srv.mr.is_leader()
+            if lead.all():
+                break
+            srv._campaign(~lead)
+            time.sleep(0.3)
+    print("READY", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -46,7 +46,9 @@ def measure(name, fn, buf, k=K):
         return jax.lax.fori_loop(0, kk, body, jnp.uint32(0))
 
     try:
-        int(loop(buf, 2))  # compile + 2 warm iters
+        # warm with the SAME static k: a different k is a different
+        # executable and its compile would land in the timed region
+        int(loop(buf, k))
         t0 = time.perf_counter()
         int(loop(buf, k))
         dt = time.perf_counter() - t0

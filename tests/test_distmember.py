@@ -1,0 +1,195 @@
+"""DistMember engine: batched cross-host consensus rounds exchanged
+as wire frames between in-process members (the fake-network pattern,
+raft_test.go:1203-1263, at the frame level)."""
+
+import numpy as np
+import pytest
+
+from etcd_tpu.raft.distmember import DistMember
+from etcd_tpu.wire.distmsg import (
+    AppendBatch,
+    AppendResp,
+    VoteReq,
+    VoteResp,
+    unmarshal_any,
+)
+
+G, M, CAP = 8, 3, 64
+
+
+def make_cluster(g=G, m=M, cap=CAP):
+    return [DistMember(g, m, s, cap) for s in range(m)]
+
+
+def elect(ms, slot=0, mask=None):
+    """One full campaign round-trip for member ``slot``."""
+    mask = np.ones(ms[slot].g, bool) if mask is None else mask
+    req_frame = ms[slot].begin_campaign(mask).marshal()
+    req = unmarshal_any(req_frame)
+    votes = []
+    for peer in range(len(ms)):
+        if peer == slot:
+            continue
+        votes.append(unmarshal_any(
+            ms[peer].handle_vote(req).marshal()))
+    return ms[slot].tally(req.active, votes)
+
+
+def replicate(ms, lead=0, drop=()):
+    """One append round-trip from ``lead`` to every peer; ``drop`` is
+    a set of peer slots whose frames vanish (either direction)."""
+    for peer in range(len(ms)):
+        if peer == lead or peer in drop:
+            continue
+        b = ms[lead].build_append(peer)
+        if b is None:
+            continue
+        resp = ms[peer].handle_append(
+            unmarshal_any(b.marshal()))
+        ms[lead].handle_append_resp(unmarshal_any(resp.marshal()))
+
+
+def test_frame_roundtrip():
+    b = AppendBatch(
+        sender=1, term=np.arange(4, dtype=np.int32),
+        prev_idx=np.arange(4, dtype=np.int32),
+        prev_term=np.zeros(4, np.int32),
+        n_ents=np.asarray([2, 0, 1, 0], np.int32),
+        commit=np.zeros(4, np.int32),
+        active=np.asarray([1, 1, 0, 0], bool),
+        need_snap=np.zeros(4, bool),
+        ent_terms=np.ones((4, 2), np.int32),
+        payloads=[[b"aa", b"b"], [], [b"ccc"], []])
+    got = unmarshal_any(b.marshal())
+    assert isinstance(got, AppendBatch) and got.sender == 1
+    assert got.payloads[0] == [b"aa", b"b"]
+    assert got.payloads[2] == [b"ccc"]
+    assert np.array_equal(got.n_ents, b.n_ents)
+
+    r = AppendResp(sender=2, term=np.ones(4, np.int32),
+                   ok=np.asarray([1, 0, 1, 0], bool),
+                   acked=np.arange(4, dtype=np.int32),
+                   hint=np.zeros(4, np.int32),
+                   active=np.ones(4, bool))
+    got = unmarshal_any(r.marshal())
+    assert isinstance(got, AppendResp)
+    assert np.array_equal(got.ok, r.ok)
+
+    v = VoteReq(sender=0, term=np.ones(4, np.int32),
+                last=np.zeros(4, np.int32),
+                lterm=np.zeros(4, np.int32),
+                active=np.ones(4, bool))
+    assert isinstance(unmarshal_any(v.marshal()), VoteReq)
+    vr = VoteResp(sender=1, term=np.ones(4, np.int32),
+                  granted=np.ones(4, bool), active=np.ones(4, bool))
+    assert isinstance(unmarshal_any(vr.marshal()), VoteResp)
+
+
+def test_election_and_commit():
+    ms = make_cluster()
+    won = elect(ms, 0)
+    assert won.all()
+    assert ms[0].is_leader().all()
+    # becoming-leader empty entry + a real proposal
+    ms[0].propose(np.ones(G, np.int32),
+                  data=[[b""] for _ in range(G)])
+    valid, base = ms[0].propose(
+        np.ones(G, np.int32), data=[[b"x"] for _ in range(G)])
+    assert valid.all() and (base == 1).all()
+    replicate(ms, 0)
+    assert (ms[0].commit_index() == 2).all()
+    # commit propagates to followers on the NEXT round
+    replicate(ms, 0)
+    assert (ms[1].commit_index() == 2).all()
+    assert ms[1].committed_payload(0, 2) == b"x"
+
+
+def test_quorum_commits_with_one_peer_down():
+    ms = make_cluster()
+    elect(ms, 0)
+    ms[0].propose(np.ones(G, np.int32),
+                  data=[[b""] for _ in range(G)])
+    replicate(ms, 0)
+    before = ms[0].commit_index().copy()
+    ms[0].propose(np.ones(G, np.int32),
+                  data=[[b"y"] for _ in range(G)])
+    replicate(ms, 0, drop={2})       # only peer 1 answers
+    assert (ms[0].commit_index() == before + 1).all()
+
+
+def test_reject_repairs_next_from_hint():
+    ms = make_cluster()
+    elect(ms, 0)
+    ms[0].propose(np.ones(G, np.int32),
+                  data=[[b""] for _ in range(G)])
+    # peer 2 misses 3 rounds
+    for i in range(3):
+        ms[0].propose(np.ones(G, np.int32),
+                      data=[[bytes([i])] for _ in range(G)])
+        replicate(ms, 0, drop={2})
+    # peer 2 now gets a frame whose prev it lacks -> reject+hint,
+    # leader repairs next_, second round delivers the backlog
+    replicate(ms, 0)
+    replicate(ms, 0)
+    assert (ms[2].commit_index() >= 3).all()
+
+
+def test_higher_term_deposes_leader():
+    ms = make_cluster()
+    elect(ms, 0)
+    ms[0].propose(np.ones(G, np.int32),
+                  data=[[b""] for _ in range(G)])
+    replicate(ms, 0)
+    # member 1 campaigns at a higher term and wins
+    won = elect(ms, 1)
+    assert won.all()
+    # the old leader learns the new term from the next response
+    b = ms[0].build_append(1)
+    if b is not None:
+        resp = ms[1].handle_append(unmarshal_any(b.marshal()))
+        ms[0].handle_append_resp(unmarshal_any(resp.marshal()))
+    assert not ms[0].is_leader().any()
+
+
+def test_vote_durability_shape():
+    """begin_campaign bumps terms before any frame ships (the caller
+    persists the ballot between these two steps)."""
+    ms = make_cluster()
+    t0 = ms[0].terms().copy()
+    req = ms[0].begin_campaign(np.ones(G, bool))
+    assert (ms[0].terms() == t0 + 1).all()
+    assert (req.term == t0 + 1).all()
+
+
+def test_need_snap_flag_past_compaction():
+    ms = make_cluster(cap=16)
+    elect(ms, 0)
+    ms[0].propose(np.ones(G, np.int32),
+                  data=[[b""] for _ in range(G)])
+    for i in range(6):
+        ms[0].propose(np.ones(G, np.int32),
+                      data=[[bytes([i])] for _ in range(G)])
+        replicate(ms, 0, drop={2})
+    ms[0].mark_applied(ms[0].commit_index())
+    ms[0].compact()
+    b = ms[0].build_append(2)
+    assert b is not None and b.need_snap.all()
+    # follower pulls + installs the snapshot, then appends resume
+    frontier = ms[0].commit_index()
+    terms = ms[0].commit_terms()
+    inst = ms[2].install_snapshot(frontier, terms)
+    assert inst.all()
+    # leader learns the new match from the next reject/hint cycle
+    replicate(ms, 0)
+    replicate(ms, 0)
+    assert (ms[2].commit_index() >= frontier).all()
+
+
+def test_partial_mask_campaign():
+    ms = make_cluster()
+    mask = np.zeros(G, bool)
+    mask[:3] = True
+    won = elect(ms, 1, mask)
+    assert won[:3].all() and not won[3:].any()
+    assert ms[1].is_leader()[:3].all()
+    assert not ms[1].is_leader()[3:].any()

@@ -1,0 +1,190 @@
+"""Distributed multi-group server: 3 hosts on localhost HTTP, real
+frames over real sockets (the reference's in-process cluster test
+upgraded to actual transport, server_test.go:370-447 +
+cluster_store.go:106-156 semantics)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from etcd_tpu.server.distserver import DistServer
+from etcd_tpu.wire.requests import Request
+
+G = 8
+_NEXT_ID = [1]
+
+
+def rid() -> int:
+    _NEXT_ID[0] += 1
+    return _NEXT_ID[0]
+
+
+def free_ports(n):
+    import socket
+
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def make_cluster(tmp_path, m=3, g=G, ports=None, **kw):
+    ports = ports or free_ports(m)
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    servers = []
+    for s in range(m):
+        # election = 60 ticks (3s): first-round jit compiles and the
+        # shared-CPU test host push round latency past the production
+        # 0.5-1s window; the protocol is what's under test, not the
+        # timing margin
+        srv = DistServer(
+            str(tmp_path / f"d{s}"), slot=s, peer_urls=urls, g=g,
+            cap=64, tick_interval=0.05, post_timeout=2.0,
+            election=60, **kw)
+        srv.start()
+        servers.append(srv)
+    return servers, ports
+
+
+def put(srv, key, val, timeout=10.0):
+    return srv.do(Request(method="PUT", id=rid(), path=key, val=val),
+                  timeout=timeout)
+
+
+def get(srv, key):
+    return srv.do(Request(method="GET", id=rid(), path=key))
+
+
+def wait_for(pred, timeout=15.0, msg="condition"):
+    from etcd_tpu.utils.errors import EtcdError
+
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        try:
+            if pred():
+                return
+        except EtcdError:
+            pass  # e.g. key not replicated yet
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    servers, ports = make_cluster(tmp_path)
+    # bootstrap: host 0 campaigns for every group; races with peer
+    # timers can depose individual lanes, so converge on host 0
+    # holding every lane (re-campaign any lane it lost)
+    deadline = time.time() + 30.0
+    while time.time() < deadline:
+        lead = servers[0].mr.is_leader()
+        if lead.all():
+            break
+        servers[0]._campaign(~lead)
+        time.sleep(0.3)
+    assert servers[0].mr.is_leader().all(), "bootstrap election"
+    yield servers, ports, tmp_path
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:
+            pass
+
+
+def test_write_commits_and_replicates(cluster):
+    servers, _, _ = cluster
+    ev = put(servers[0], "/foo", "bar")
+    assert ev.event.node.value == "bar"
+    # replication reaches follower replicas within a few rounds
+    wait_for(lambda: all(
+        get(s, "/foo").event.node.value == "bar"
+        for s in servers[1:]), msg="replication to followers")
+
+
+def test_follower_forwards_writes(cluster):
+    servers, _, _ = cluster
+    # follower must learn the leader before it can forward
+    wait_for(lambda: (servers[1].mr.leader_hint() == 0).all(),
+             msg="leader hint propagation")
+    ev = put(servers[1], "/fwd", "v1")
+    assert ev.event.node.value == "v1"
+    wait_for(lambda: get(servers[0], "/fwd").event.node.value == "v1",
+             msg="forwarded write on leader")
+
+
+def test_survives_one_host_down(cluster):
+    servers, _, _ = cluster
+    put(servers[0], "/a", "1")
+    servers[2].stop()          # hard loss of one member
+    # quorum of 2/3 keeps committing
+    ev = put(servers[0], "/a", "2", timeout=15.0)
+    assert ev.event.node.value == "2"
+    wait_for(lambda: get(servers[1], "/a").event.node.value == "2",
+             msg="replication with one host down")
+
+
+def test_restart_catches_up_from_wal(cluster):
+    servers, ports, tmp_path = cluster
+    for i in range(5):
+        put(servers[0], f"/k{i}", f"v{i}")
+    servers[1].stop()
+    for i in range(5, 10):
+        put(servers[0], f"/k{i}", f"v{i}", timeout=15.0)
+    # restart host 1 from its own WAL; replication repairs the gap
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    s1 = DistServer(str(tmp_path / "d1"), slot=1, peer_urls=urls,
+                    g=G, cap=64, tick_interval=0.05,
+                    post_timeout=2.0)
+    # pre-restart state survived (committed prefix is in the store)
+    assert get(s1, "/k0").event.node.value == "v0"
+    s1.start()
+    servers[1] = s1
+    wait_for(lambda: all(
+        get(s1, f"/k{i}").event.node.value == f"v{i}"
+        for i in range(10)), msg="restarted host catch-up")
+
+
+def test_snapshot_pull_past_compaction(cluster):
+    servers, ports, tmp_path = cluster
+    put(servers[0], "/base", "x")
+    servers[2].stop()
+    # drive the leader far past the dead member, then snapshot +
+    # compact so its log no longer reaches the laggard
+    for i in range(30):
+        put(servers[0], f"/s{i}", f"v{i}", timeout=15.0)
+    servers[0].snapshot()
+    # restart the laggard: appends reject -> need_snap -> pull
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    s2 = DistServer(str(tmp_path / "d2"), slot=2, peer_urls=urls,
+                    g=G, cap=64, tick_interval=0.05,
+                    post_timeout=2.0)
+    s2.start()
+    servers[2] = s2
+    wait_for(lambda: all(
+        get(s2, f"/s{i}").event.node.value == f"v{i}"
+        for i in range(30)), timeout=30.0,
+        msg="snapshot pull catch-up")
+
+
+def test_leader_failover_elects_new_leader(cluster):
+    servers, _, _ = cluster
+    put(servers[0], "/f", "1")
+    wait_for(lambda: all(
+        get(s, "/f").event.node.value == "1" for s in servers),
+        msg="initial replication")
+    servers[0].stop()          # kill the leader of every group
+    # a surviving member's election timers fire and win 2/3 quorums
+    wait_for(lambda: (servers[1].mr.is_leader()
+                      | servers[2].mr.is_leader()).all(),
+             timeout=30.0, msg="failover election")
+    new_lead = servers[1] if servers[1].mr.is_leader().any() \
+        else servers[2]
+    ev = put(new_lead, "/f", "2", timeout=20.0)
+    assert ev.event.node.value == "2"

@@ -130,3 +130,44 @@ def test_commit_index_batch():
     out = np.asarray(maybe_commit_batch(match, n, committed, term,
                                         log_terms, offset))
     assert list(out) == [5, 0, 0]
+
+
+def test_gf2_inverse_roundtrip():
+    for k in (1, 4, 7, 256):
+        z = gf2.zero_operator(k)
+        zi = gf2.inverse(z)
+        assert np.array_equal(gf2.matmul(z, zi), gf2.identity())
+        assert np.array_equal(gf2.matmul(zi, z), gf2.identity())
+
+
+def test_inject_seeds_chain_parity():
+    """Seed injection folds update(prev, m) into one raw matmul:
+    raw(rows') ^ ~0 == update(prev, m) for arbitrary prev values."""
+    rng = np.random.default_rng(11)
+    L, N = 128, 150
+    lens = rng.integers(0, L - 4 + 1, size=N)
+    prev = rng.integers(0, 2**32, size=N, dtype=np.uint32)
+    rows = np.zeros((N, L), dtype=np.uint8)
+    expect = np.empty(N, np.uint32)
+    for i, l in enumerate(lens):
+        m = rng.integers(0, 256, size=l, dtype=np.uint8).tobytes()
+        rows[i, L - l:] = np.frombuffer(m, dtype=np.uint8)
+        expect[i] = crc32c.update(int(prev[i]), m)
+    crc_device.inject_seeds(rows, lens, prev)
+    raw = np.asarray(crc_device.raw_crc_batch(rows, use_pallas=False))
+    assert np.array_equal(raw ^ np.uint32(0xFFFFFFFF), expect)
+    ok = np.asarray(crc_device.chain_links_injected(raw, expect))
+    assert ok.all()
+    # corruption detection: flip a byte in one record
+    bad = rows.copy()
+    bad[2, L - 1] ^= 0x40
+    raw_bad = np.asarray(crc_device.raw_crc_batch(bad, use_pallas=False))
+    ok_bad = np.asarray(crc_device.chain_links_injected(raw_bad, expect))
+    assert not ok_bad[2] and ok_bad[3:].all()
+
+
+def test_inject_seeds_rejects_tight_rows():
+    rows = np.zeros((1, 8), np.uint8)
+    with pytest.raises(ValueError):
+        crc_device.inject_seeds(rows, np.asarray([5]),
+                                np.asarray([0], np.uint32))
