@@ -55,6 +55,8 @@ C2_PROPOSALS = int(os.environ.get("BENCH_C2_PROPOSALS", 100_000))
 C3_SNAP_MB = int(os.environ.get("BENCH_C3_SNAP_MB", 256))
 C4_GROUPS = int(os.environ.get("BENCH_C4_GROUPS", 10_000))
 C4_ROUNDS = int(os.environ.get("BENCH_C4_ROUNDS", 30))
+RESTART_ENTRIES = int(os.environ.get("BENCH_RESTART_ENTRIES",
+                                     1_000_000))
 # Accelerator init can be slow behind a device tunnel; probe generously
 # but never hang the bench (round-1 failure mode: backend init hung;
 # round-2: a 240s budget expired and forced a degraded CPU run — the
@@ -188,16 +190,18 @@ def bench_cluster_commits(total: int) -> float | None:
     mr.campaign(0)
     per_round = np.full(g, 4, np.int32)
     rounds = max(1, total // (g * 4))
-    mr.propose(per_round)  # warmup/compile
+    # 8-round fused trains between compactions: one device dispatch
+    # per train instead of one per round (propose_rounds docstring)
+    train = 8
+    mr.propose_rounds(per_round, train)  # warmup/compile
     mr.mark_applied(mr.commit_index())
     mr.compact()
     t0 = time.perf_counter()
     done = 0
-    for i in range(rounds):
-        done += int(mr.propose(per_round).sum())
-        if (i + 1) % 8 == 0:
-            mr.mark_applied(mr.commit_index())
-            mr.compact()
+    for _ in range(max(1, rounds // train)):
+        done += int(mr.propose_rounds(per_round, train).sum())
+        mr.mark_applied(mr.commit_index())
+        mr.compact()
     dt = time.perf_counter() - t0
     log(f"config2: {done} proposals through {g} x 3-member clusters "
         f"in {dt:.2f}s = {done / dt / 1e3:.1f}k/s")
@@ -241,7 +245,7 @@ def bench_snapshot(mb: int, backend: str) -> dict | None:
 
 
 def bench_group_latency(g: int, rounds: int) -> dict | None:
-    """Config 4: p50/p99 commit-round latency at g groups x 5 members
+    """Config 4: commit-round latency at g groups x 5 members
     (the batched maybeCommit+append being scaled, raft.go:248-258)."""
     import numpy as np
 
@@ -250,25 +254,125 @@ def bench_group_latency(g: int, rounds: int) -> dict | None:
     mr = MultiRaft(g=g, m=5, cap=64)
     mr.campaign(0)
     one = np.ones(g, np.int32)
+    # Per-dispatch latency (the interactive shape: one batched round
+    # per serving-loop turn) — a handful of dispatches is enough for
+    # a p50 and keeps tunnel time bounded.
     mr.propose(one)  # warmup/compile
     lats = []
-    for i in range(rounds):
+    for i in range(min(rounds, 8)):
         t0 = time.perf_counter()
         newly = mr.propose(one)
         lats.append(time.perf_counter() - t0)
         assert int(newly.sum()) == g
-        if (i + 1) % 16 == 0:
-            mr.mark_applied(mr.commit_index())
-            mr.compact()
+    mr.mark_applied(mr.commit_index())
+    mr.compact()
     lats_ms = np.sort(np.asarray(lats)) * 1e3
     p50 = float(np.percentile(lats_ms, 50))
-    p99 = float(np.percentile(lats_ms, 99))
-    eps = g / (p50 / 1e3)
-    log(f"config4: {g} groups x 5 members, {rounds} rounds: "
-        f"p50 {p50:.1f}ms p99 {p99:.1f}ms "
-        f"({eps / 1e6:.2f}M group-commits/s at p50)")
-    return {"p50_ms": round(p50, 2), "p99_ms": round(p99, 2),
+    # <=8 samples: the honest tail figure is the max, not a "p99"
+    lat_max = float(lats_ms[-1])
+    # Fused train (the batch shape: K rounds in ONE dispatch — no
+    # per-round host sync); mean round time is the honest figure
+    # there, reported separately from the per-dispatch p50.
+    k = max(1, rounds - len(lats))
+    mr.propose_rounds(one, k)  # warmup/compile at this static k
+    mr.mark_applied(mr.commit_index())
+    mr.compact()
+    t0 = time.perf_counter()
+    newly = mr.propose_rounds(one, k)
+    fused_s = time.perf_counter() - t0
+    assert int(newly.sum()) == g * k
+    fused_ms = fused_s / k * 1e3
+    eps = g / (fused_ms / 1e3)
+    log(f"config4: {g} groups x 5 members: per-dispatch p50 "
+        f"{p50:.1f}ms max {lat_max:.1f}ms; fused x{k} "
+        f"{fused_ms:.2f}ms/round ({eps / 1e6:.2f}M group-commits/s)")
+    return {"p50_ms": round(p50, 2), "max_ms": round(lat_max, 2),
+            "fused_round_ms": round(fused_ms, 3),
+            "fused_rounds": k,
             "group_commits_per_sec": round(eps, 0)}
+
+
+def bench_restart(n: int, g: int = 64, window: int = 10_000) -> dict:
+    """Multi-group restart replay at scale (VERDICT r2 weakness #5):
+    a data dir whose WAL holds ``n`` GroupEntry records, snapshot
+    covering all but ``window`` applies (the reference's snapCount
+    shape, server.go:29) — construction time of MultiGroupServer IS
+    the restart, dominated by the replay parse the array lane
+    (server/gereplay.py + native ge_scan) accelerates."""
+    import hashlib
+    import shutil
+    import tempfile
+
+    from etcd_tpu.server.multigroup import MultiGroupServer
+    from etcd_tpu.snap import Snapshotter
+    from etcd_tpu.store import Store
+    from etcd_tpu.wal import WAL
+    from etcd_tpu.wire import Entry, GroupEntry, HardState, Snapshot
+    from etcd_tpu.wire.requests import Info, Request
+
+    d = tempfile.mkdtemp()
+    try:
+        name = "multigroup"
+        sid = int.from_bytes(
+            hashlib.sha1(name.encode()).digest()[:8],
+            "big") & (2**63 - 1)
+        os.makedirs(f"{d}/snap")
+        w = WAL.create(f"{d}/wal", Info(id=sid).marshal())
+        k_per = max(1, n // g)
+        n = k_per * g
+        # small payload pool: parse cost is per-record regardless;
+        # only the post-snapshot window ever applies to the store
+        pool = [Request(method="PUT", id=i + 1,
+                        path=f"/ns{i}/k", val="v").marshal()
+                for i in range(64)]
+        t0 = time.perf_counter()
+        seq = 0
+        batch = []
+        for idx in range(1, k_per + 1):
+            for gi in range(g):
+                seq += 1
+                batch.append(Entry(
+                    index=seq, term=1,
+                    data=GroupEntry(kind=0, group=gi, gindex=idx,
+                                    gterm=1,
+                                    payload=pool[seq % 64]).marshal()))
+                if len(batch) >= 8192:
+                    w.save(HardState(term=1, vote=0, commit=seq),
+                           batch)
+                    batch = []
+        frontier = np.full(g, k_per, np.int32)
+        terms = np.ones(g, np.int32)
+        seq += 1
+        batch.append(Entry(
+            index=seq, term=1,
+            data=GroupEntry(kind=1, payload=frontier.tobytes()
+                            + terms.tobytes()).marshal()))
+        w.save(HardState(term=1, vote=0, commit=seq), batch)
+        w.close()
+        snap_k = max(0, k_per - max(1, window // g))
+        snap_seq = snap_k * g
+        Snapshotter(f"{d}/snap").save_snap(Snapshot(
+            data=json.dumps({
+                "store": Store().save().decode(),
+                "frontier": [snap_k] * g,
+                "terms": [1] * g,
+                "seq": snap_seq,
+                "applied_total": snap_seq,
+            }).encode(), index=snap_seq, term=1))
+        log(f"restart: built {n} records in "
+            f"{time.perf_counter() - t0:.1f}s")
+
+        t0 = time.perf_counter()
+        srv = MultiGroupServer(d, g=g, m=3)
+        dt = time.perf_counter() - t0
+        assert srv.raft_index >= n - snap_seq
+        srv.wal.close()
+        log(f"restart: {n} records replayed in {dt:.2f}s "
+            f"= {n / dt / 1e6:.2f}M records/s")
+        return {"entries": n, "seconds": round(dt, 2),
+                "entries_per_sec": round(n / dt, 0)}
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
 
 
 def run_extra_configs(extra: dict, backend: str) -> None:
@@ -294,6 +398,11 @@ def run_extra_configs(extra: dict, backend: str) -> None:
             extra["config4"] = bench_group_latency(C4_GROUPS, C4_ROUNDS)
         except Exception as e:
             log(f"config4 failed: {e!r}")
+    if RESTART_ENTRIES:
+        try:
+            extra["restart_replay"] = bench_restart(RESTART_ENTRIES)
+        except Exception as e:
+            log(f"restart bench failed: {e!r}")
 
 
 def measure_sustained(jax, rows, stored, iters):

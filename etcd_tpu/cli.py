@@ -258,10 +258,21 @@ def start_etcd(args, cluster: Cluster, explicit: set[str]) -> int:
 
 
 def start_proxy(args, cluster: Cluster, explicit: set[str]) -> int:
-    """Reference startProxy (main.go:212-249)."""
+    """Reference startProxy (main.go:212-249) + discovery bootstrap
+    (main.go:253-275's glue): with --discovery set, the proxy's
+    endpoint list comes from the discovery registry instead of the
+    flag-built cluster."""
     client_tls = TLSInfo(args.cert_file, args.key_file, args.ca_file)
-    addrs = [urllib.parse.urlsplit(u).netloc
-             for u in cluster.peer_urls_all()]
+    peer_urls = cluster.peer_urls_all()
+    if args.discovery:
+        from .discovery.discovery import proxy_endpoints
+
+        discovered = proxy_endpoints(args.discovery)
+        if discovered:
+            peer_urls = discovered
+            log.info("proxy: discovered %d endpoints via %s",
+                     len(discovered), args.discovery)
+    addrs = [urllib.parse.urlsplit(u).netloc for u in peer_urls]
     scheme = "https" if not client_tls.empty() else "http"
     handler = NewProxyHandler(
         addrs, scheme=scheme,

@@ -126,3 +126,28 @@ class Discoverer:
 def nodes_to_cluster(nodes) -> str:
     """Reference discovery.go:213-218."""
     return ",".join(n["value"] for n in nodes)
+
+
+def proxy_endpoints(durl: str, client=None) -> list[str]:
+    """Read the member peer URLs a discovery cluster has registered —
+    the proxy-mode bootstrap (a proxy is not a member: it reads the
+    registry without createSelf/waitNodes, then proxies to whatever
+    peers exist).  Returns the registered peer URLs.
+    """
+    u = urllib.parse.urlsplit(durl)
+    cluster = u.path.strip("/")
+    if client is None:
+        from ..api.client import Client
+
+        base = urllib.parse.urlunsplit((u.scheme, u.netloc, "", "", ""))
+        client = Client([base])
+    resp = client.get(f"/{cluster}", recursive=False, sorted=True)
+    nodes = [n for n in resp["node"].get("nodes", [])
+             if not n["key"].rsplit("/", 1)[-1].startswith("_")]
+    nodes.sort(key=lambda n: n.get("createdIndex", 0))
+    urls = []
+    for n in nodes:
+        # registry values are "name=peerurl" pairs (nodes_to_cluster)
+        val = n.get("value", "")
+        urls.append(val.split("=", 1)[1] if "=" in val else val)
+    return [x for x in urls if x]

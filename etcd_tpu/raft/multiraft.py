@@ -200,6 +200,32 @@ def _fused_round(states, leader, n_new, drop, e):
             overflow, conflict)
 
 
+@partial(jax.jit, static_argnames=("e", "k"))
+def _fused_multi_round(states, leader, n_new, drop, e, k):
+    """``k`` consecutive fused rounds in ONE device dispatch.
+
+    The per-round host sync in :meth:`MultiRaft.propose` (valid/base/
+    overflow materialized to numpy every call) costs ~65 ms per
+    dispatch on a tunneled device — at 30 bench rounds that is pure
+    transport, not consensus.  Payload-less callers (benchmarks,
+    idle heartbeat trains, catch-up replication bursts) don't need
+    the per-round keying arrays, so the whole train runs device-side
+    with a single commit-delta readback.
+
+    Returns ``(states', newly_committed_total, overflow, conflict)``.
+    """
+    def body(_, carry):
+        states, total, overflow, conflict = carry
+        states, newly, _valid, _base, o, c = _fused_round(
+            states, leader, n_new, drop, e)
+        return states, total + newly, overflow | o, conflict | c
+
+    g = leader.shape[0]
+    init = (states, jnp.zeros((g,), jnp.int32),
+            jnp.zeros((g,), bool), jnp.zeros((g,), bool))
+    return jax.lax.fori_loop(0, k, body, init)
+
+
 @partial(jax.jit, static_argnames=("slot",))
 def _fused_campaign(states, mask, drop, slot):
     """Batched campaign for member ``slot`` (raft.go:358-370), fused.
@@ -367,6 +393,31 @@ class MultiRaft:
                 for j, blob in enumerate(data[gi][:int(n_new[gi])]):
                     self.payloads[gi][int(self.last_base[gi]) + 1 + j] \
                         = blob
+        return np.asarray(newly)
+
+    def propose_rounds(self, n_new: np.ndarray, rounds: int,
+                       drop=None) -> np.ndarray:
+        """``rounds`` consecutive payload-less propose→commit rounds
+        fused into ONE device dispatch (each round appends
+        ``n_new[g]`` entries at the leader and completes a full
+        replicate→respond→commit exchange).  Returns the per-group
+        TOTAL of newly committed entries.
+
+        For callers that track payloads use :meth:`propose` — this
+        path skips the per-round valid/base keying in exchange for
+        eliminating the per-round host↔device sync (the dominant cost
+        behind a device tunnel, and a dispatch-latency saving on any
+        backend)."""
+        g = self.g
+        dense = self._no_drop if not drop else \
+            jnp.asarray(_drop_dense(drop, self.m, g))
+        states, newly, overflow, conflict = _fused_multi_round(
+            tuple(self.states), jnp.asarray(self.leader),
+            jnp.asarray(np.asarray(n_new, np.int32)), dense,
+            e=self.e, k=rounds)
+        self.states = list(states)
+        self.errors["overflow"] = np.asarray(overflow)
+        self.errors["conflict"] = np.asarray(conflict)
         return np.asarray(newly)
 
     def replicate(self, drop=None) -> np.ndarray:

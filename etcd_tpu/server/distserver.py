@@ -199,64 +199,69 @@ class DistServer:
         snap_frontier = frontier.copy()
         self.seq = snap_index
 
-        self.wal, md, _hs, ents = _replay_wal(
+        from .gereplay import scan as ge_stream_scan, seed_log_arrays
+        from .server import _replay_wal_raw
+
+        self.wal, md, _hs, raw = _replay_wal_raw(
             self._waldir, snap_index, self.backend)
         info = Info.unmarshal(md or b"")
         if info.id != self.id:
             raise RuntimeError(
                 f"unexpected server id {info.id:x}, want {self.id:x}")
 
-        winners: dict[tuple[int, int], GroupEntry] = {}
+        # array pass (gereplay): one native envelope sweep; frontier/
+        # ballot = last record of their kind; winner dedup vectorized
+        stream = ge_stream_scan(raw)
+        if len(stream):
+            self.seq = max(self.seq, int(stream.seq.max()))
         terms = np.zeros(g, np.int32)
         votes = np.full(g, -1, np.int32)
-        for e in ents:
-            ge = GroupEntry.unmarshal(e.data)
-            if ge.kind == K_ENTRY:
-                winners[(ge.group, ge.gindex)] = ge
-            elif ge.kind == K_FRONTIER:
-                v = np.frombuffer(ge.payload, np.int32)
-                if v.size != 2 * g:
-                    raise RuntimeError(
-                        f"data dir written with g={v.size // 2}, "
-                        f"not {g}")
-                # frontier records are monotonic in stream order:
-                # the last one wins (newer than the snapshot too)
-                frontier = v[:g].astype(np.int64)
-                fterms = v[g:].astype(np.int64)
-            elif ge.kind == K_BALLOT:
-                v = np.frombuffer(ge.payload, np.int32)
-                terms = v[:g].copy()
-                votes = v[g:2 * g].copy()
-            self.seq = max(self.seq, e.index)
+        fpos = stream.last_of_kind(K_FRONTIER)
+        if fpos >= 0:
+            v = np.frombuffer(stream.payload(fpos), np.int32)
+            if v.size != 2 * g:
+                raise RuntimeError(
+                    f"data dir written with g={v.size // 2}, not {g}")
+            # frontier records are monotonic in stream order: the
+            # last one wins (newer than the snapshot too)
+            frontier = v[:g].astype(np.int64)
+            fterms = v[g:].astype(np.int64)
+        bpos = stream.last_of_kind(K_BALLOT)
+        if bpos >= 0:
+            v = np.frombuffer(stream.payload(bpos), np.int32)
+            terms = v[:g].copy()
+            votes = v[g:2 * g].copy()
 
-        # committed prefix → store (stream order by (group, gindex))
-        applied_n = 0
-        for (gi, idx) in sorted(winners.keys()):
-            if not (snap_frontier[gi] < idx <= frontier[gi]):
-                continue
-            ge = winners[(gi, idx)]
-            if ge.payload:
-                r = Request.unmarshal(ge.payload)
-                apply_request_to_store(self.store, r)
-            applied_n += 1
+        # committed prefix → store, in (group, gindex) order
+        winners = stream.winner_positions()
+        committed = winners[
+            (stream.gindex[winners] > snap_frontier[
+                stream.group[winners]])
+            & (stream.gindex[winners] <= frontier[
+                stream.group[winners]])]
+        committed = committed[np.lexsort(
+            (stream.gindex[committed], stream.group[committed]))]
+        applied_n = int(committed.size)
+        for k in committed:
+            payload = stream.payload(int(k))
+            if payload:
+                apply_request_to_store(self.store,
+                                       Request.unmarshal(payload))
 
         # engine seeding: compacted-at-frontier log + contiguous tail
+        # (acked-but-uncommitted entries MUST survive — the leader
+        # counted our ack toward quorum), rebuilt in arrays
         mr = self.mr
         import jax.numpy as jnp
 
-        last = frontier.copy()
         cap = mr.cap
-        log_term = np.zeros((g, cap), np.int32)
-        for gi in range(g):
-            log_term[gi, 0] = fterms[gi]
-            idx = int(frontier[gi]) + 1
-            while (gi, idx) in winners and idx - frontier[gi] < cap:
-                ge = winners[(gi, idx)]
-                log_term[gi, idx - int(frontier[gi])] = ge.gterm
-                if ge.payload:
-                    mr.payloads[gi][idx] = ge.payload
-                idx += 1
-            last[gi] = idx - 1
+        log_term, last, tail_pos = seed_log_arrays(
+            stream, winners, frontier, fterms, g, cap)
+        for k in tail_pos:
+            payload = stream.payload(int(k))
+            if payload:
+                mr.payloads[int(stream.group[k])][
+                    int(stream.gindex[k])] = payload
         terms = np.maximum(terms, fterms.astype(np.int32))
         fr = jnp.asarray(frontier, jnp.int32)
         st = mr.state._replace(
@@ -271,7 +276,7 @@ class DistServer:
         self.raft_term = int(terms.max()) if g else 0
         self._snapi = self.raft_index
         log.info("dist[%d]: restart — %d replayed, %d applied, "
-                 "tail up to %s", self.slot, len(ents), applied_n,
+                 "tail up to %s", self.slot, len(stream), applied_n,
                  int(last.max()) if g else 0)
 
     # -- lifecycle --------------------------------------------------------

@@ -82,6 +82,9 @@ class _Pending:
     data: bytes
     id: int
     retries: int = 0
+    # explicit group routing (ConfChange entries target a group
+    # directly instead of hashing a client path)
+    group: int | None = None
 
 
 class MultiGroupServer:
@@ -94,10 +97,16 @@ class MultiGroupServer:
                  max_batch_ents: int = 32,
                  tick_interval: float = TICK_INTERVAL,
                  sync_interval: float = 0.5,
+                 spare_member_slots: int = 1,
                  client_urls: list[str] | None = None):
         from ..raft.multiraft import MultiRaft
 
-        self.g, self.m = g, m
+        # ``m`` live members now; ``spare_member_slots`` empty slots
+        # are allocated so runtime AddMember has somewhere to land
+        # (batched state is static-shaped — slots are pre-sized, the
+        # members mask is what a committed ConfChange flips)
+        self.g, self.m = g, m + spare_member_slots
+        self.live = m
         self.name = name
         self.snap_count = snap_count or DEFAULT_SNAP_COUNT
         self.backend = storage_backend
@@ -142,8 +151,9 @@ class MultiGroupServer:
         if wal_exist(self._waldir):
             self._restart(cap, max_batch_ents)
         else:
-            self.mr = MultiRaft(g, m, cap,
-                                max_batch_ents=max_batch_ents)
+            self.mr = MultiRaft(g, self.m, cap,
+                                max_batch_ents=max_batch_ents,
+                                live=self.live)
             self.wal = WAL.create(self._waldir,
                                   Info(id=self.id).marshal())
             # seq-0 zero-frontier marker: WAL replay requires entry
@@ -194,45 +204,55 @@ class MultiGroupServer:
         # an empty post-snapshot tail must not reset the sequence
         self.seq = snap_index
 
-        self.wal, md, hard_state, ents = _replay_wal(
+        from .gereplay import scan as ge_stream_scan
+        from .server import _replay_wal_raw
+
+        self.wal, md, hard_state, raw = _replay_wal_raw(
             self._waldir, snap_index, self.backend)
         info = Info.unmarshal(md or b"")
         if info.id != self.id:
             raise RuntimeError(
                 f"unexpected server id {info.id:x}, want {self.id:x}")
 
-        # pass 1: last record wins per (group, gindex); frontier =
-        # last marker
-        winners: dict[tuple[int, int], int] = {}
-        parsed: list[GroupEntry] = []
-        for k, e in enumerate(ents):
-            ge = GroupEntry.unmarshal(e.data)
-            parsed.append(ge)
-            if ge.kind == 0:
-                winners[(ge.group, ge.gindex)] = k
-            elif ge.kind == 1:
-                v = np.frombuffer(ge.payload, np.int32)
-                if v.size != 2 * g:
-                    raise RuntimeError(
-                        f"data dir was written with "
-                        f"--cohosted-groups {v.size // 2}, not {g}; "
-                        f"group routing would silently change")
-                frontier = v[:g].astype(np.int64)
-                terms = v[g:2 * g].astype(np.int64)
-            self.seq = max(self.seq, e.index)
+        # array pass: ONE native envelope sweep + vectorized
+        # last-record-wins dedup and frontier selection — the device
+        # replay hands back struct-of-arrays and the restart stays in
+        # that shape instead of walking 1M GroupEntry objects
+        # (round-2 weakness #5)
+        stream = ge_stream_scan(raw)
+        if len(stream):
+            self.seq = max(self.seq, int(stream.seq.max()))
+        fpos = stream.last_of_kind(1)
+        if fpos >= 0:
+            v = np.frombuffer(stream.payload(fpos), np.int32)
+            if v.size != 2 * g:
+                raise RuntimeError(
+                    f"data dir was written with --cohosted-groups "
+                    f"{v.size // 2}, not {g}; group routing would "
+                    f"silently change")
+            frontier = v[:g].astype(np.int64)
+            terms = v[g:2 * g].astype(np.int64)
 
-        # pass 2: apply committed winners in stream order
-        applied_n = 0
-        for k, ge in enumerate(parsed):
-            if ge.kind != 0 or winners.get((ge.group, ge.gindex)) != k:
+        # committed winners apply in stream order; only the applying
+        # slice materializes Python objects (CONFCHANGE entries touch
+        # the engine, not the store — they re-apply after seeding)
+        winners = stream.winner_positions()
+        committed = winners[
+            (stream.gindex[winners] > snap_frontier[
+                stream.group[winners]])
+            & (stream.gindex[winners] <= frontier[
+                stream.group[winners]])]
+        conf_changes: list[tuple[int, Request]] = []
+        applied_n = int(committed.size)
+        for k in committed:
+            payload = stream.payload(int(k))
+            if not payload:
                 continue
-            if not (snap_frontier[ge.group] < ge.gindex
-                    <= frontier[ge.group]):
-                continue
-            if ge.payload:
-                r = Request.unmarshal(ge.payload)
+            r = Request.unmarshal(payload)
+            if r.method == "CONFCHANGE":
+                conf_changes.append((int(stream.group[k]), r))
+            else:
                 apply_request_to_store(self.store, r)
-            applied_n += 1
 
         self.applied = frontier.copy()
         self.raft_index = applied_total + applied_n
@@ -244,18 +264,47 @@ class MultiGroupServer:
         # slot 0 carries the frontier term for match checks)
         import jax.numpy as jnp
 
-        mr = MultiRaft(g, self.m, cap, max_batch_ents=max_batch_ents)
+        mr = MultiRaft(g, self.m, cap, max_batch_ents=max_batch_ents,
+                       live=self.live)
         fr = jnp.asarray(frontier, jnp.int32)
         tm = jnp.asarray(terms, jnp.int32)
         slot0 = jnp.zeros((g, cap), jnp.int32).at[:, 0].set(tm)
+        members = None
+        if snap is not None and "members" in blob:
+            msnap = np.asarray(blob["members"], bool)
+            if msnap.shape[1] < self.m:
+                # restart with MORE spare slots: pad the mask (new
+                # slots start empty — the add_member migration path)
+                msnap = np.pad(msnap,
+                               ((0, 0), (0, self.m - msnap.shape[1])))
+            elif msnap.shape[1] > self.m:
+                extra = msnap[:, self.m:]
+                if extra.any():
+                    raise RuntimeError(
+                        f"snapshot uses member slot(s) >= {self.m}; "
+                        f"restart with spare_member_slots >= "
+                        f"{msnap.shape[1] - self.live}")
+                msnap = msnap[:, :self.m]
+            members = jnp.asarray(msnap)
         for s in range(self.m):
             st = mr.states[s]
-            mr.states[s] = st._replace(
+            st = st._replace(
                 term=tm, offset=fr, last=fr, commit=fr, applied=fr,
                 log_term=slot0)
+            if members is not None:
+                st = st._replace(
+                    members=members,
+                    nmembers=members.sum(axis=1).astype(jnp.int32))
+            mr.states[s] = st
         self.mr = mr
-        log.info("multigroup: replayed %d entries, %d applied, "
-                 "max term %d", len(ents), applied_n, self.raft_term)
+        # committed ConfChanges in the replayed window re-apply to
+        # the fresh engine (the snapshot's members mask carries
+        # everything below it)
+        for gi, r in conf_changes:
+            self._apply_conf_change(gi, r)
+        log.info("multigroup: replayed %d records, %d applied, "
+                 "max term %d", len(stream), applied_n,
+                 self.raft_term)
 
     # -- lifecycle --------------------------------------------------------
 
@@ -357,6 +406,65 @@ class MultiGroupServer:
 
         raise UnknownMethodError(r.method)
 
+    # -- runtime membership (server.go:382-404, 542-559 batched) ----------
+
+    def add_member(self, slot: int,
+                   timeout: float | None = 30.0) -> None:
+        """Grow every group's cluster to include member ``slot``: one
+        ConfChange entry per group, proposed through THAT group's log
+        and applied only once committed (quorum under the OLD
+        membership authorizes the change, as in the reference's
+        ProposeConfChange → applyConfChange path)."""
+        self._conf_change(True, slot, timeout)
+
+    def remove_member(self, slot: int,
+                      timeout: float | None = 30.0) -> None:
+        """Shrink every group's cluster: the removed slot's progress
+        stops counting toward quorums the moment the entry commits;
+        a removed leader's groups elect fresh on the next timeout."""
+        self._conf_change(False, slot, timeout)
+
+    def _conf_change(self, add: bool, slot: int,
+                     timeout: float | None) -> None:
+        if not (0 <= slot < self.m):
+            raise ValueError(
+                f"slot {slot} out of range (allocated {self.m} "
+                f"member slots; grow spare_member_slots to add more)")
+        payload = json.dumps({"add": bool(add), "slot": int(slot)})
+        chans = []
+        for gi in range(self.g):
+            r = Request(method="CONFCHANGE", id=gen_id(),
+                        path=f"/_confchange/{gi}", val=payload)
+            ch = self.w.register(r.id)
+            chans.append((r.id, ch))
+            self._queue.put(_Pending(req=r, data=r.marshal(),
+                                     id=r.id, group=gi))
+        deadline = None if timeout is None else time.time() + timeout
+        for rid, ch in chans:
+            left = None if deadline is None \
+                else max(deadline - time.time(), 0.01)
+            try:
+                x = ch.get(timeout=left)
+            except queue.Empty:
+                self.w.trigger(rid, None)
+                raise TimeoutError(
+                    "conf change timed out (some groups uncommitted)")
+            if x is None:
+                raise ServerStoppedError() if self.done.is_set() \
+                    else TimeoutError("conf change dropped")
+
+    def _apply_conf_change(self, gi: int, r: Request) -> None:
+        d = json.loads(r.val)
+        mask = np.zeros(self.g, bool)
+        mask[gi] = True
+        self.mr.apply_conf_change(bool(d["add"]), int(d["slot"]),
+                                  mask=mask)
+
+    def members_of(self, gi: int) -> np.ndarray:
+        """[M] live-membership mask of group ``gi`` (slot capacity M;
+        quorum = live//2 + 1)."""
+        return np.asarray(self.mr.states[0].members)[gi]
+
     # -- RaftTimer --------------------------------------------------------
 
     def index(self) -> int:
@@ -403,7 +511,8 @@ class MultiGroupServer:
                 while q and len(items[gi]) < mr.e:
                     items[gi].append(q.popleft())
             for p in batch:
-                gi = group_of(p.req.path, self.g)
+                gi = p.group if p.group is not None \
+                    else group_of(p.req.path, self.g)
                 if len(items[gi]) >= mr.e:
                     self._requeue[gi].append(p)
                     continue
@@ -540,7 +649,15 @@ class MultiGroupServer:
                 resp = None
                 if payload:
                     r = Request.unmarshal(payload)
-                    resp = apply_request_to_store(self.store, r)
+                    if r.method == "CONFCHANGE":
+                        # committed membership change: flip the
+                        # engine's members mask for THIS group
+                        # (reference applyConfChange,
+                        # server.go:542-559)
+                        self._apply_conf_change(int(gi), r)
+                        resp = Response()
+                    else:
+                        resp = apply_request_to_store(self.store, r)
                 self.raft_index += 1
                 p = assigned.pop((int(gi), idx), None)
                 if p is not None:
@@ -566,6 +683,10 @@ class MultiGroupServer:
             "terms": [int(x) for x in terms],
             "seq": self.seq,
             "applied_total": self.raft_index,
+            # per-group live-membership mask: conf changes below the
+            # snapshot don't need their entries replayed
+            "members": np.asarray(self.mr.states[0].members)
+            .astype(int).tolist(),
         }).encode()
         with tracer.span("mg.snapshot"):
             self.ss.save_snap(Snapshot(data=blob, index=self.seq,

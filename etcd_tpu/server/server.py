@@ -426,9 +426,11 @@ class EtcdServer:
 _DEVICE_REPLAY_MIN_BYTES = 8 << 20
 
 
-def _replay_wal(waldir: str, index: int, backend: str):
-    """WAL replay honoring --storage-backend (the north-star seam:
-    same (metadata, state, entries) out of either execution path)."""
+def _replay_wal_raw(waldir: str, index: int, backend: str):
+    """WAL replay honoring --storage-backend; the device path keeps
+    entries as an un-materialized ``EntryBlock`` (struct-of-arrays —
+    the form array-based consumers like gereplay.scan feed on), the
+    host path yields an Entry list."""
     if backend != "host":
         size = sum(
             os.path.getsize(os.path.join(waldir, f))
@@ -442,7 +444,7 @@ def _replay_wal(waldir: str, index: int, backend: str):
                         waldir, index)
                 log.info("etcdserver: device replay of %d entries "
                          "(%d bytes)", len(block), size)
-                return w, md, hard_state, block.entries()
+                return w, md, hard_state, block
             except Exception:
                 if backend == "tpu":
                     raise
@@ -452,6 +454,17 @@ def _replay_wal(waldir: str, index: int, backend: str):
         w = WAL.open_at_index(waldir, index)
         md, hard_state, ents = w.read_all()
     return w, md, hard_state, ents
+
+
+def _replay_wal(waldir: str, index: int, backend: str):
+    """WAL replay honoring --storage-backend (the north-star seam:
+    same (metadata, state, entries) out of either execution path)."""
+    from ..wal.replay_device import EntryBlock
+
+    w, md, hard_state, out = _replay_wal_raw(waldir, index, backend)
+    if isinstance(out, EntryBlock):
+        out = out.entries()
+    return w, md, hard_state, out
 
 
 def new_server(cfg: ServerConfig, *, discoverer=None,

@@ -200,3 +200,58 @@ def test_discovery_truncates_to_size():
                    client=FakeClient(2, nodes))
     out = d.discover()
     assert out == "n1=http://a:7001,n2=http://b:7001"
+
+
+def test_proxy_endpoints_from_discovery():
+    """Proxy-mode bootstrap (reference main.go:253-275 glue): the
+    endpoint list comes from the discovery registry, skipping hidden
+    keys, ordered by createdIndex."""
+    nodes = [
+        {"key": "/c/2", "value": "n2=http://b:7001", "createdIndex": 2},
+        {"key": "/c/1", "value": "n1=http://a:7001", "createdIndex": 1},
+        {"key": "/c/_config", "value": "", "createdIndex": 0},
+        {"key": "/c/3", "value": "http://bare:7001", "createdIndex": 3},
+    ]
+    out = disc_mod.proxy_endpoints(
+        "http://disc.example.com/c", client=FakeClient(3, nodes))
+    assert out == ["http://a:7001", "http://b:7001",
+                   "http://bare:7001"]
+
+
+def test_proxy_endpoints_live_server(tmp_path):
+    """End to end: a real etcd server acts as the discovery service;
+    members register; proxy_endpoints reads them back over HTTP."""
+    import socket
+
+    from etcd_tpu.api.http import make_client_handler, serve
+    from etcd_tpu.server.cluster import Cluster
+    from etcd_tpu.server.server import (
+        ServerConfig,
+        new_server,
+    )
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    cluster = Cluster()
+    cluster.set_from_string("disc=http://127.0.0.1:1")
+    cfg = ServerConfig(
+        name="disc", data_dir=str(tmp_path / "d"), cluster=cluster,
+        client_urls=[f"http://127.0.0.1:{port}"])
+    srv = new_server(cfg)
+    srv.tick_interval = 0.01
+    srv.start()
+    httpd = serve(make_client_handler(srv), "127.0.0.1", port)
+    try:
+        from etcd_tpu.api.client import Client
+
+        c = Client([f"http://127.0.0.1:{port}"])
+        c.create("/cl/1", "n1=http://a:7001")
+        c.create("/cl/2", "n2=http://b:7001")
+        out = disc_mod.proxy_endpoints(
+            f"http://127.0.0.1:{port}/cl")
+        assert out == ["http://a:7001", "http://b:7001"]
+    finally:
+        httpd.shutdown()
+        srv.stop()

@@ -1,0 +1,107 @@
+"""Array-form GroupEntry replay (server/gereplay.py): native sweep vs
+Python fallback parity, winner dedup, tail contiguity."""
+
+import numpy as np
+import pytest
+
+from etcd_tpu import native
+from etcd_tpu.server import gereplay
+from etcd_tpu.wal.replay_device import EntryBlock
+from etcd_tpu.wire import Entry, GroupEntry
+
+
+def make_entries(records):
+    """records: list of (kind, group, gindex, gterm, payload)."""
+    return [Entry(index=i + 1, term=1,
+                  data=GroupEntry(kind=k, group=g, gindex=gi,
+                                  gterm=gt, payload=p).marshal())
+            for i, (k, g, gi, gt, p) in enumerate(records)]
+
+
+def to_block(entries):
+    """Entry list -> EntryBlock (the device-replay output shape:
+    each data span holds the MARSHALED ENTRY bytes, the GroupEntry
+    nests inside its field 4)."""
+    blob = bytearray()
+    off = np.empty(len(entries), np.uint64)
+    ln = np.empty(len(entries), np.uint64)
+    for i, e in enumerate(entries):
+        eb = e.marshal()
+        off[i] = len(blob)
+        ln[i] = len(eb)
+        blob += eb
+    return EntryBlock(
+        index=np.asarray([e.index for e in entries], np.uint64),
+        term=np.asarray([e.term for e in entries], np.uint64),
+        type=np.zeros(len(entries), np.uint64),
+        data_off=off, data_len=ln,
+        blob=np.frombuffer(bytes(blob), np.uint8))
+
+
+RECORDS = [
+    (0, 0, 1, 1, b"a"),
+    (0, 1, 1, 1, b"b"),
+    (1, 0, 0, 0, np.arange(4, dtype=np.int32).tobytes()),
+    (0, 0, 2, 1, b"c-old"),
+    (0, 0, 2, 2, b"c-new"),      # overwrites (0, 2)
+    (2, 0, 0, 0, np.arange(4, dtype=np.int32).tobytes()),
+    (0, 1, 2, 2, None),          # fence (no payload)
+]
+
+
+def test_native_and_python_scans_agree():
+    entries = make_entries(RECORDS)
+    py = gereplay.scan(entries)
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    nat = gereplay.scan(to_block(entries))
+    assert nat.plist is None  # really took the native path
+    for field in ("seq", "kind", "group", "gindex", "gterm"):
+        assert np.array_equal(getattr(py, field), getattr(nat, field))
+    for i in range(len(py)):
+        assert py.payload(i) == nat.payload(i)
+
+
+def test_winner_dedup_last_record_wins():
+    s = gereplay.scan(make_entries(RECORDS))
+    w = s.winner_positions()
+    # positions 0, 1, 4 (not 3 — overwritten), 6
+    assert list(w) == [0, 1, 4, 6]
+    assert s.payload(4) == b"c-new"
+    assert s.last_of_kind(1) == 2
+    assert s.last_of_kind(2) == 5
+    assert s.last_of_kind(7) == -1
+
+
+def test_seed_log_arrays_contiguity():
+    g, cap = 3, 8
+    frontier = np.asarray([2, 0, 5], np.int64)
+    fterms = np.asarray([1, 0, 2], np.int64)
+    recs = [
+        (0, 0, 3, 2, b"t1"),   # tail rel 1
+        (0, 0, 4, 2, b"t2"),   # tail rel 2
+        (0, 0, 6, 2, b"gap"),  # rel 4: gap at 3 -> dropped
+        (0, 1, 1, 1, b"u1"),   # tail rel 1
+        (0, 2, 2, 9, b"old"),  # below frontier: not tail
+    ]
+    s = gereplay.scan(make_entries(recs))
+    log_term, last, tail_pos = gereplay.seed_log_arrays(
+        s, s.winner_positions(), frontier, fterms, g, cap)
+    assert list(last) == [4, 1, 5]
+    assert log_term[0, 0] == 1 and log_term[0, 1] == 2 \
+        and log_term[0, 2] == 2
+    assert log_term[0, 4] == 0          # gap garbage zeroed
+    assert log_term[1, 1] == 1
+    assert log_term[2, 0] == 2
+    got = {(int(s.group[k]), int(s.gindex[k])) for k in tail_pos}
+    assert got == {(0, 3), (0, 4), (1, 1)}
+
+
+def test_empty_stream():
+    s = gereplay.scan([])
+    assert len(s) == 0
+    assert s.winner_positions().size == 0
+    log_term, last, tail = gereplay.seed_log_arrays(
+        s, s.winner_positions(), np.zeros(2, np.int64),
+        np.zeros(2, np.int64), 2, 4)
+    assert list(last) == [0, 0]

@@ -484,3 +484,28 @@ def test_compact_prunes_payloads():
     mr.mark_applied(mr.commit_index())
     mr.compact()
     assert mr.committed_payload(0, 2) is None  # pruned below offset
+
+
+def test_propose_rounds_matches_serial():
+    """The fused K-round train commits exactly what K serial rounds
+    commit (same engine, one dispatch)."""
+    from etcd_tpu.raft.multiraft import MultiRaft
+
+    a = MultiRaft(g=4, m=3, cap=64)
+    b = MultiRaft(g=4, m=3, cap=64)
+    a.campaign(0)
+    b.campaign(0)
+    one = np.ones(4, np.int32)
+    serial = np.zeros(4, np.int64)
+    for _ in range(5):
+        serial += a.propose(one)
+    fused = b.propose_rounds(one, 5)
+    assert np.array_equal(serial, fused)
+    assert np.array_equal(a.commit_index(), b.commit_index())
+    # overflow lanes surface identically
+    for _ in range(40):
+        a.propose(one)
+    c = MultiRaft(g=4, m=3, cap=64)
+    c.campaign(0)
+    c.propose_rounds(one, 40)
+    assert np.array_equal(a.errors["overflow"], c.errors["overflow"])
