@@ -1,13 +1,17 @@
-"""North-star benchmarks (BASELINE configs 1-4).
+"""North-star benchmarks (BASELINE configs 1-5 + restart replay).
 
 Config 1 (the primary JSON metric): multi-group WAL replay with CRC
-parity.  Configs 2-4 run after it and land in the JSON line's extra
+parity.  The rest run after it and land in the JSON line's extra
 fields + stderr:
 
   config 2 — in-process 3-member cluster commit throughput
              (TestClusterOf3's shape, batched over groups)
   config 3 — large snapshot save/load with device hashing
-  config 4 — p50 commit-round latency at 10k groups x 5 members
+  config 4 — commit-round latency at 100k groups x 5 members
+             (per-dispatch p50/max + fused-train mean)
+  config 5 — the mesh-sharded step at 100k groups (virtual 8-device
+             CPU mesh subprocess, labeled as such)
+  restart_replay — 1M-record multi-group restart wall time
 
 Scenario (BASELINE configs 1 & 4's shape): G co-hosted raft groups
 each replay an N/G-entry WAL segment (256 B payloads).  The reference
@@ -53,8 +57,9 @@ THREADS = int(os.environ.get("BENCH_THREADS",
 # configs 2-4 knobs (0 disables a config)
 C2_PROPOSALS = int(os.environ.get("BENCH_C2_PROPOSALS", 100_000))
 C3_SNAP_MB = int(os.environ.get("BENCH_C3_SNAP_MB", 256))
-C4_GROUPS = int(os.environ.get("BENCH_C4_GROUPS", 10_000))
+C4_GROUPS = int(os.environ.get("BENCH_C4_GROUPS", 100_000))
 C4_ROUNDS = int(os.environ.get("BENCH_C4_ROUNDS", 30))
+C5_GROUPS = int(os.environ.get("BENCH_C5_GROUPS", 100_000))
 RESTART_ENTRIES = int(os.environ.get("BENCH_RESTART_ENTRIES",
                                      1_000_000))
 # Accelerator init can be slow behind a device tunnel; probe generously
@@ -403,6 +408,51 @@ def run_extra_configs(extra: dict, backend: str) -> None:
             extra["restart_replay"] = bench_restart(RESTART_ENTRIES)
         except Exception as e:
             log(f"restart bench failed: {e!r}")
+    if C5_GROUPS:
+        try:
+            r = bench_sharded_step(C5_GROUPS)
+            if r is not None:
+                extra["config5"] = r
+        except Exception as e:
+            log(f"config5 failed: {e!r}")
+
+
+def bench_sharded_step(groups: int) -> dict | None:
+    """Config 5: the mesh-sharded step at ``groups`` groups.  Real
+    multi-chip hardware is not reachable from this harness, so the
+    measurement runs the same sharded program on the 8-virtual-device
+    CPU mesh in a subprocess (clean backend) and says so in its
+    ``backend`` field — a measured wall time for the sharded step,
+    not a TPU claim."""
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "config5_bench.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags + " --xla_force_host_platform_device_count=8"
+                 ).strip()
+    env["XLA_FLAGS"] = flags
+    try:
+        out = subprocess.run(
+            [sys.executable, script, str(groups), "4"],
+            capture_output=True, timeout=600, env=env, text=True)
+    except subprocess.TimeoutExpired:
+        log("config5 subprocess timed out")
+        return None
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            r = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(r, dict) and "groups" in r:
+            log(f"config5: {r['groups']} groups sharded {r['mesh']}: "
+                f"{r['step_ms']}ms/step")
+            return r
+    tail = out.stderr.strip().splitlines()
+    log(f"config5 subprocess rc={out.returncode}: "
+        f"{tail[-1] if tail else '?'}")
+    return None
 
 
 def measure_sustained(jax, rows, stored, iters):

@@ -12,6 +12,7 @@ from .mesh import (
     group_mesh,
     make_replay_commit_step,
     make_sharded_step,
+    place_step_inputs,
     replay_commit_local,
     shard_leading,
 )
@@ -21,6 +22,7 @@ __all__ = [
     "group_mesh",
     "make_replay_commit_step",
     "make_sharded_step",
+    "place_step_inputs",
     "replay_commit_local",
     "shard_leading",
 ]

@@ -111,6 +111,27 @@ def data_plane_step(buf, lens, stored, seed, state: GroupState,
     return links_ok, state, err, ncomm
 
 
+def place_step_inputs(mesh: Mesh, args):
+    """Shard a :func:`data_plane_step` argument tuple onto ``mesh``
+    (the one placement recipe the dryrun and the config-5 bench both
+    use — keep it HERE so a new argument is placed once, not in two
+    divergent copies): ``buf`` over ``P('g', 's')``, every [G, ...]
+    array and the GroupState pytree over ``P('g')``; the seed scalar
+    stays replicated."""
+    from jax.sharding import NamedSharding
+
+    (buf, lens, stored, seed, state, n_new, self_slot, resp_slots,
+     resp_idx, resp_mask) = args
+    buf = jax.device_put(buf, NamedSharding(mesh, P("g", "s")))
+    (lens, stored, n_new, self_slot, resp_slots, resp_idx,
+     resp_mask) = (shard_leading(mesh, x) for x in (
+         lens, stored, n_new, self_slot, resp_slots, resp_idx,
+         resp_mask))
+    state = jax.tree.map(lambda x: shard_leading(mesh, x), state)
+    return (buf, lens, stored, seed, state, n_new, self_slot,
+            resp_slots, resp_idx, resp_mask)
+
+
 def make_sharded_step(mesh: Mesh):
     """jit-compiled mesh-sharded :func:`data_plane_step`.
 

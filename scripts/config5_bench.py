@@ -1,0 +1,77 @@
+"""Config 5 (BASELINE configs[4]): G raft groups sharded over a
+device mesh — batched leader append + msgAppResp absorb + quorum
+commit with the match-index quorum running under the mesh's
+collectives (parallel/mesh.py make_sharded_step).
+
+Real v5e-8 hardware is not reachable from this harness (one tunneled
+chip), so this measures the SAME sharded program on the virtual
+N-device CPU mesh the test suite uses and labels the result
+accordingly — a measured number for the sharded step's wall time, not
+a TPU throughput claim.
+
+Prints ONE JSON line; run via bench.py or standalone:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python scripts/config5_bench.py [GROUPS] [ITERS]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    groups = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+
+    from __graft_entry__ import _example_args
+    from etcd_tpu.parallel import (
+        group_mesh,
+        make_sharded_step,
+        place_step_inputs,
+    )
+
+    mesh = group_mesh(len(jax.devices()))
+    ng, ns = mesh.shape["g"], mesh.shape["s"]
+    g = max(1, groups // ng) * ng
+    args = place_step_inputs(mesh, _example_args(
+        n=8 * ng, max_len=8 * ns, g=g, m=5, cap=32))
+
+    step = make_sharded_step(mesh)
+
+    def once():
+        out = step(*args)
+        jax.block_until_ready(out)
+        return out
+
+    t0 = time.perf_counter()
+    out = once()  # compile
+    compile_s = time.perf_counter() - t0
+    assert bool(np.all(np.asarray(out[3]) == 2)), "commit stalled"
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        once()
+    dt = (time.perf_counter() - t0) / iters
+    print(json.dumps({
+        "groups": g, "members": 5,
+        "mesh": f"{ng}x{ns} ({len(jax.devices())} virtual cpu "
+                f"devices)",
+        "backend": "virtual-cpu-mesh",
+        "step_ms": round(dt * 1e3, 2),
+        "compile_s": round(compile_s, 1),
+        "group_commits_per_sec": round(2 * g / dt, 0),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""Generate the committed reference-layout WAL + snapshot fixture
+(tests/fixtures/refdir) and print its SHA256 pins.
+
+No Go toolchain exists in this image, so the fixture cannot be
+emitted by the reference binary itself; it is hand-assembled to the
+reference's exact on-disk layout — gogoproto field order pinned by
+the golden bytes in tests/test_wire.py, file naming
+%016x-%016x.{wal,snap} (wal/util.go:77-88, snap/snapshotter.go:47),
+int64-LE length framing (wal/decoder.go:30-35), rolling CRC chain
+seeded 0 with crcType records across cuts (wal/wal.go:184-237), and
+snappb whole-file CRC (snap/snapshotter.go:39-60).  The fixture is
+deterministic: regenerating must reproduce the pinned hashes.
+"""
+
+import hashlib
+import json
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from etcd_tpu.snap import Snapshotter  # noqa: E402
+from etcd_tpu.wal import WAL  # noqa: E402
+from etcd_tpu.wire import Entry, HardState, Snapshot  # noqa: E402
+from etcd_tpu.wire.requests import Info, Request  # noqa: E402
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "..", "tests",
+                      "fixtures", "refdir")
+
+# Deterministic content: 12 committed PUTs in two WAL segments plus a
+# store snapshot at entry 8 (the mid-stream cut exercises the chained
+# crcType record the reference writes on every segment roll).
+NODE_ID = 0x1234567890ABCDEF
+
+
+def main() -> None:
+    shutil.rmtree(FIXDIR, ignore_errors=True)
+    os.makedirs(os.path.join(FIXDIR, "snap"))
+    waldir = os.path.join(FIXDIR, "wal")
+
+    w = WAL.create(waldir, Info(id=NODE_ID).marshal())
+    # open-at-0 streams start at the dummy entry 0, the reference's
+    # raft-log seed shape (wal/wal_test.go:163's ents begin {0, 0})
+    w.save(HardState(term=1, vote=1, commit=0),
+           [Entry(index=0, term=0)])
+    for i in range(1, 9):
+        r = Request(method="PUT", id=i, path=f"/fix/k{i}",
+                    val=f"v{i}")
+        w.save(HardState(term=1, vote=1, commit=i),
+               [Entry(index=i, term=1, data=r.marshal())])
+    w.cut()  # segment roll: chained crc record into 0000..0008.wal
+    for i in range(9, 13):
+        r = Request(method="PUT", id=i, path=f"/fix/k{i}",
+                    val=f"v{i}")
+        w.save(HardState(term=2, vote=1, commit=i),
+               [Entry(index=i, term=2, data=r.marshal())])
+    w.close()
+
+    # store snapshot at index 8: the tree the first 8 PUTs build,
+    # in the reference's store.Save() JSON shape
+    from etcd_tpu.store import Store
+    from etcd_tpu.server.server import apply_request_to_store
+
+    st = Store()
+    for i in range(1, 9):
+        apply_request_to_store(st, Request(
+            method="PUT", id=i, path=f"/fix/k{i}", val=f"v{i}"))
+    Snapshotter(os.path.join(FIXDIR, "snap")).save_snap(Snapshot(
+        index=8, term=1, data=st.save()))
+
+    pins = {}
+    for root, _dirs, files in os.walk(FIXDIR):
+        for f in sorted(files):
+            p = os.path.join(root, f)
+            rel = os.path.relpath(p, FIXDIR)
+            pins[rel] = hashlib.sha256(
+                open(p, "rb").read()).hexdigest()
+    print(json.dumps(pins, indent=2, sort_keys=True))
+
+
+if __name__ == "__main__":
+    main()
