@@ -91,6 +91,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "mode")
     p.add_argument("--cohosted-members", type=int, default=3,
                    help="Members per co-hosted group (default 3)")
+    p.add_argument("--dist-slot", type=int, default=-1,
+                   help="Run the DISTRIBUTED multi-group server as "
+                        "member slot N of --dist-peers: each host "
+                        "owns one member of every co-hosted group, "
+                        "rounds exchange batched frames over HTTP "
+                        "(-1 = off)")
+    p.add_argument("--dist-peers", default="",
+                   help="Comma-separated slot-indexed peer base URLs "
+                        "for --dist-slot mode (this host's own slot "
+                        "included)")
     # v0.4.6 back-compat (main.go:87-98)
     p.add_argument("--addr", default=None,
                    help="DEPRECATED: Use --advertise-client-urls instead.")
@@ -163,9 +173,60 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.proxy != PROXY_VALUE_OFF:
         return start_proxy(args, cluster, explicit)
+    if args.dist_slot >= 0:
+        return start_dist(args, explicit)
     if args.cohosted_groups > 0:
         return start_multigroup(args, explicit)
     return start_etcd(args, cluster, explicit)
+
+
+def start_dist(args, explicit: set[str]) -> int:
+    """Distributed multi-group mode: this process is ONE member slot
+    of every co-hosted group; peers listed in --dist-peers carry the
+    other slots (server/distserver.py).  The standard /v2 client API
+    serves from the local replica; writes route to group leaders."""
+    from .server.distserver import DistServer
+
+    peers = [u.strip() for u in args.dist_peers.split(",") if u.strip()]
+    if len(peers) < 2 or not (0 <= args.dist_slot < len(peers)):
+        log.error("dist mode needs --dist-peers with >=2 slot-indexed "
+                  "URLs and --dist-slot within range")
+        return 1
+    data_dir = args.data_dir or f"{args.name}_dist{args.dist_slot}_data"
+    os.makedirs(data_dir, mode=0o700, exist_ok=True)
+    g = args.cohosted_groups or 64
+    client_tls = TLSInfo(args.cert_file, args.key_file, args.ca_file)
+    acurls = urls_from_flags(args, "advertise_client_urls", "addr",
+                             explicit, client_tls.empty())
+    # member identity folds the slot in: hosts commonly share a
+    # --name (the default!), and identical names would collapse to
+    # one sha1 id whose registry entries overwrite each other
+    s = DistServer(data_dir, slot=args.dist_slot, peer_urls=peers,
+                   g=g, name=f"{args.name}-{args.dist_slot}",
+                   snap_count=args.snapshot_count,
+                   storage_backend=args.storage_backend,
+                   client_urls=list(acurls))
+    s.start()
+    if args.dist_slot == 0 and s.fresh:
+        # slot 0 bootstraps leadership for a BRAND-NEW cluster only
+        # (fresh = no prior WAL); a restarted slot 0 must rejoin via
+        # ordinary elections — mass-campaigning here would depose
+        # every established leader on the surviving hosts
+        import numpy as np
+
+        s._campaign(np.ones(g, bool))
+    cors = parse_cors(args.cors) if args.cors else None
+    ch = make_client_handler(s, cors=cors)
+    lcurls = urls_from_flags(args, "listen_client_urls", "bind_addr",
+                             explicit, client_tls.empty())
+    for u in lcurls:
+        host, port = _split_hostport(u)
+        serve(ch, host, port, new_listener_context(client_tls))
+        log.info("Listening for client requests on %s (dist slot "
+                 "%d/%d, %d groups)", u, args.dist_slot, len(peers), g)
+
+    _block_forever()
+    return 0
 
 
 def start_multigroup(args, explicit: set[str]) -> int:

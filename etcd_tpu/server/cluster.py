@@ -188,6 +188,14 @@ class ClusterStore:
                 return c
             raise
         for n in e.node.nodes or []:
+            if len(n.nodes or []) != 2:
+                # half-published member (its two attribute keys
+                # commit as separate replicated writes): skip until
+                # the second lands rather than 500 the reader.
+                # ONLY the structural case is skipped — a corrupt
+                # value (json error) must still surface, not be
+                # silently indistinguishable from mid-publish
+                continue
             c.add(node_to_member(n))
         return c
 

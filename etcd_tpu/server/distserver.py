@@ -104,7 +104,8 @@ class DistServer:
                  sync_interval: float = 0.5,
                  post_timeout: float = 1.0,
                  election: int = 10,
-                 storage_backend: str = "auto"):
+                 storage_backend: str = "auto",
+                 client_urls: list[str] | None = None):
         self.slot = slot
         self.g, self.m = g, len(peer_urls)
         self.peer_urls = list(peer_urls)
@@ -122,6 +123,16 @@ class DistServer:
         self.w = Wait()
         self.done = threading.Event()
         self.lock = threading.RLock()
+        # serving seams the v2 HTTP layer mounts against (api/http.py
+        # reads do/index/term/store/stats/cluster_store — the same
+        # surface EtcdServer and MultiGroupServer expose)
+        from .cluster import ClusterStore
+        from .stats import LeaderStats, ServerStats
+
+        self.server_stats = ServerStats(self.name, self.id)
+        self.leader_stats = LeaderStats(self.id)
+        self.cluster_store = ClusterStore(self.store)
+        self._client_urls = client_urls or []
         self._queue: queue.Queue[_Pending | None] = queue.Queue()
         self._requeue: list[deque] = [deque() for _ in range(g)]
         self._need_pull = False      # snapshot catch-up requested
@@ -152,7 +163,12 @@ class DistServer:
         self.mr = DistMember(g, self.m, slot, cap,
                              election=election,
                              max_batch_ents=max_batch_ents, seed=slot)
-        if wal_exist(self._waldir):
+        # fresh = brand-new data dir (callers gate bootstrap-only
+        # actions like the slot-0 mass campaign on this, NOT on
+        # is_leader() — leadership is volatile and always empty
+        # after a restart)
+        self.fresh = not wal_exist(self._waldir)
+        if not self.fresh:
             self._restart()
         else:
             self.wal = WAL.create(self._waldir,
@@ -283,6 +299,7 @@ class DistServer:
 
     def start(self) -> None:
         """Bind the peer listener and start the round loop."""
+        threading.Thread(target=self._publish, daemon=True).start()
         u = urlparse(self.peer_urls[self.slot])
         handler = _make_peer_handler(self)
         self._httpd = ThreadingHTTPServer((u.hostname, u.port),
@@ -292,6 +309,39 @@ class DistServer:
                          daemon=True).start()
         self._thread = threading.Thread(target=self.run, daemon=True)
         self._thread.start()
+
+    def _publish(self) -> None:
+        """Register this member under /_etcd/machines THROUGH
+        consensus (server.go:463-491's publish retry loop): a
+        local-replica write would diverge from the other replicas, so
+        the registration is an ordinary replicated PUT, retried until
+        a leader exists to commit it."""
+        import uuid
+
+        from .cluster import (
+            ATTRIBUTES_SUFFIX,
+            RAFT_ATTRIBUTES_SUFFIX,
+            Member,
+        )
+
+        m = Member(id=self.id, name=self.name,
+                   peer_urls=[self.peer_urls[self.slot]],
+                   client_urls=self._client_urls)
+        pairs = [
+            (m.store_key() + RAFT_ATTRIBUTES_SUFFIX,
+             json.dumps(m.raft_attributes.to_dict())),
+            (m.store_key() + ATTRIBUTES_SUFFIX,
+             json.dumps(m.attributes.to_dict())),
+        ]
+        while not self.done.is_set():
+            try:
+                for path, val in pairs:
+                    self.do(Request(
+                        method="PUT", id=uuid.uuid4().int >> 65,
+                        path=path, val=val), timeout=5.0)
+                return
+            except Exception:
+                self.done.wait(1.0)  # no leader yet; retry
 
     def stop(self) -> None:
         self.done.set()
