@@ -119,13 +119,15 @@ class DistMember:
 
     def __init__(self, g: int, m: int, slot: int, cap: int,
                  election: int = 10, max_batch_ents: int = 8,
-                 seed: int | None = None):
+                 seed: int | None = None, live: int | None = None):
         # (election is in ticks; the server layer's tick_interval
-        # scales it to wall time — raft.go:611-617 randomization)
+        # scales it to wall time — raft.go:611-617 randomization;
+        # ``live`` < m leaves spare member slots for runtime
+        # AddMember, batched state being static-shaped)
         self.g, self.m, self.slot, self.cap = g, m, slot, cap
         self.e = max_batch_ents
         rng = np.random.default_rng(slot if seed is None else seed)
-        st = init_groups(g, m, cap, election=election)
+        st = init_groups(g, m, cap, election=election, live=live)
         st = st._replace(timeout=jnp.asarray(
             rng.integers(election, 2 * election, size=g), jnp.int32))
         self.state = st

@@ -70,6 +70,7 @@ from .server import (
     UnknownMethodError,
     _replay_wal,
     apply_request_to_store,
+    gen_id,
 )
 
 log = logging.getLogger(__name__)
@@ -81,11 +82,14 @@ K_BALLOT = 2     # durable term/vote: [G] terms + [G] votes
 
 
 class _Pending:
-    __slots__ = ("req", "data", "id", "retries")
+    __slots__ = ("req", "data", "id", "retries", "group")
 
-    def __init__(self, req, data, id):
+    def __init__(self, req, data, id, group=None):
         self.req, self.data, self.id = req, data, id
         self.retries = 0
+        # explicit group routing (ConfChange entries target a group
+        # directly instead of hashing a client path)
+        self.group = group
 
 
 class DistServer:
@@ -105,9 +109,19 @@ class DistServer:
                  post_timeout: float = 1.0,
                  election: int = 10,
                  storage_backend: str = "auto",
+                 live: int | None = None,
                  client_urls: list[str] | None = None):
         self.slot = slot
         self.g, self.m = g, len(peer_urls)
+        # live member slots (< m leaves spare slots for runtime
+        # AddMember; the extra peer URLs name the joinable hosts)
+        self.live = self.m if live is None else live
+        if not (0 < self.live <= self.m):
+            # an out-of-range live count would silently make quorum
+            # unattainable (nmembers is taken verbatim by the engine)
+            raise ValueError(
+                f"live={self.live} must be in 1..{self.m} "
+                f"(len(peer_urls))")
         self.peer_urls = list(peer_urls)
         self.name = name or f"dist{slot}"
         self.snap_count = snap_count or DEFAULT_SNAP_COUNT
@@ -162,7 +176,8 @@ class DistServer:
 
         self.mr = DistMember(g, self.m, slot, cap,
                              election=election,
-                             max_batch_ents=max_batch_ents, seed=slot)
+                             max_batch_ents=max_batch_ents, seed=slot,
+                             live=self.live)
         # fresh = brand-new data dir (callers gate bootstrap-only
         # actions like the slot-0 mass campaign on this, NOT on
         # is_leader() — leadership is volatile and always empty
@@ -258,11 +273,17 @@ class DistServer:
         committed = committed[np.lexsort(
             (stream.gindex[committed], stream.group[committed]))]
         applied_n = int(committed.size)
+        conf_changes: list[tuple[int, Request]] = []
         for k in committed:
             payload = stream.payload(int(k))
-            if payload:
-                apply_request_to_store(self.store,
-                                       Request.unmarshal(payload))
+            if not payload:
+                continue
+            r = Request.unmarshal(payload)
+            if r.method == "CONFCHANGE":
+                # engine-targeted: re-applies after seeding below
+                conf_changes.append((int(stream.group[k]), r))
+            else:
+                apply_request_to_store(self.store, r)
 
         # engine seeding: compacted-at-frontier log + contiguous tail
         # (acked-but-uncommitted entries MUST survive — the leader
@@ -285,7 +306,21 @@ class DistServer:
             commit=fr, applied=fr, offset=fr,
             last=jnp.asarray(last, jnp.int32),
             log_term=jnp.asarray(log_term))
+        if snap is not None and "members" in blob:
+            msnap = np.asarray(blob["members"], bool)
+            if msnap.shape[1] != self.m:
+                raise RuntimeError(
+                    f"snapshot has {msnap.shape[1]} member slots, "
+                    f"this cluster has {self.m} (len(peer_urls))")
+            mj = jnp.asarray(msnap)
+            st = st._replace(
+                members=mj, nmembers=mj.sum(axis=1).astype(jnp.int32))
         mr.state = st
+        # committed ConfChanges in the replayed window re-apply on
+        # the fresh engine (the snapshot's mask covers everything
+        # below it)
+        for gi, r in conf_changes:
+            self._apply_conf_change(gi, r)
         self._ballot = (terms.copy(), votes.copy())
         self.applied = frontier.copy()
         self.raft_index = applied_total + applied_n
@@ -316,8 +351,6 @@ class DistServer:
         local-replica write would diverge from the other replicas, so
         the registration is an ordinary replicated PUT, retried until
         a leader exists to commit it."""
-        import uuid
-
         from .cluster import (
             ATTRIBUTES_SUFFIX,
             RAFT_ATTRIBUTES_SUFFIX,
@@ -336,9 +369,8 @@ class DistServer:
         while not self.done.is_set():
             try:
                 for path, val in pairs:
-                    self.do(Request(
-                        method="PUT", id=uuid.uuid4().int >> 65,
-                        path=path, val=val), timeout=5.0)
+                    self.do(Request(method="PUT", id=gen_id(),
+                                    path=path, val=val), timeout=5.0)
                 return
             except Exception:
                 self.done.wait(1.0)  # no leader yet; retry
@@ -463,6 +495,10 @@ class DistServer:
                           self.mr.terms_at(self.applied).astype(int)],
                 "seq": self.seq,
                 "applied_total": self.raft_index,
+                # per-group live-membership at the frontier:
+                # conf changes below it need no entry replay
+                "members": np.asarray(self.mr.state.members)
+                .astype(int).tolist(),
             }).encode()
 
     # -- client path ------------------------------------------------------
@@ -477,15 +513,17 @@ class DistServer:
             raise ValueError("r.id cannot be 0")
         if r.method == "GET" and r.quorum:
             r.method = "QGET"
-        if r.method in ("POST", "PUT", "DELETE", "QGET"):
-            gi = group_of(r.path, self.g)
+        if r.method in ("POST", "PUT", "DELETE", "QGET",
+                        "CONFCHANGE"):
+            gi = self._group_of_request(r)
             data = r.marshal()
             if not self.mr.is_leader()[gi]:
                 if not forward:
                     raise TimeoutError("not leader (no re-forward)")
                 return self._forward(gi, data, timeout)
             ch = self.w.register(r.id)
-            self._queue.put(_Pending(req=r, data=data, id=r.id))
+            self._queue.put(_Pending(req=r, data=data, id=r.id,
+                                     group=gi))
             try:
                 x = ch.get(timeout=timeout)
             except queue.Empty:
@@ -506,6 +544,25 @@ class DistServer:
             ev = self.store.get(r.path, r.recursive, r.sorted)
             return Response(event=ev)
         raise UnknownMethodError(r.method)
+
+    def _group_of_request(self, r: Request) -> int:
+        """Explicit group for engine-targeted entries (a CONFCHANGE's
+        path encodes its group — hashing it like a client path would
+        route the change to the wrong group's log); namespace hash
+        for everything else."""
+        if r.method == "CONFCHANGE":
+            try:
+                gi = int(r.path.rsplit("/", 1)[-1])
+            except ValueError:
+                raise ValueError(
+                    f"malformed CONFCHANGE path {r.path!r}") from None
+            if not (0 <= gi < self.g):
+                # negative values would silently wrap to another
+                # group's log via sequence indexing
+                raise ValueError(
+                    f"CONFCHANGE group {gi} out of range 0..{self.g}")
+            return gi
+        return group_of(r.path, self.g)
 
     def _forward(self, gi: int, data: bytes,
                  timeout: float | None) -> Response:
@@ -552,9 +609,20 @@ class DistServer:
                 break
             now = time.monotonic()
             if now >= next_sync:
-                with self.lock:
-                    if self.mr.is_leader().any():
-                        self.store.delete_expired_keys(time.time())
+                # TTL expiry must be REPLICATED, not leader-local: a
+                # follower's replica would otherwise keep expired
+                # keys forever.  The reference's leader SYNC proposal
+                # (server.go:438-456) rides group 0's log here; every
+                # host expires at that entry's apply.  (Cross-group
+                # apply order is not globally serialized, so expiry
+                # interleaving vs OTHER groups' writes can differ per
+                # host by up to one sync interval — the co-hosted
+                # server documents the same class of divergence.)
+                if self.mr.is_leader()[0]:
+                    r = Request(method="SYNC", id=gen_id(),
+                                time=int(time.time() * 1e9))
+                    self._queue.put(_Pending(req=r, data=r.marshal(),
+                                             id=r.id, group=0))
                 next_sync = now + self.sync_interval
             if now >= next_tick:
                 next_tick = now + self.tick_interval
@@ -612,7 +680,8 @@ class DistServer:
                 while q and len(items[gi]) < mr.e:
                     items[gi].append(q.popleft())
             for p in batch:
-                gi = group_of(p.req.path, self.g)
+                gi = p.group if p.group is not None \
+                    else group_of(p.req.path, self.g)
                 if not lead[gi] or len(items[gi]) >= mr.e:
                     self._requeue[gi].append(p)
                     continue
@@ -754,7 +823,14 @@ class DistServer:
                 resp = None
                 if payload:
                     r = Request.unmarshal(payload)
-                    resp = apply_request_to_store(self.store, r)
+                    if r.method == "CONFCHANGE":
+                        # committed membership change for THIS group
+                        # (server.go:542-559): every host applies it
+                        # at its own apply frontier
+                        self._apply_conf_change(int(gi), r)
+                        resp = Response()
+                    else:
+                        resp = apply_request_to_store(self.store, r)
                 self.raft_index += 1
                 p = (assigned or {}).pop((int(gi), idx), None)
                 if p is not None:
@@ -763,6 +839,14 @@ class DistServer:
                     self.w.trigger(r.id, resp)
             self.applied[gi] = commit[gi]
         mr.mark_applied(self.applied)
+        # lane-fill compaction, decoupled from the snap_count-gated
+        # snapshot: periodic SYNC entries alone would fill a group's
+        # fixed-cap log window on an idle cluster long before 10k
+        # applies accumulate, wedging that lane permanently
+        st = mr.state
+        fill = np.asarray(st.last) - np.asarray(st.offset)
+        if (fill > (mr.cap * 3) // 4).any():
+            mr.compact()
         if self.raft_index - self._snapi > self.snap_count:
             self.snapshot()
 
@@ -799,12 +883,16 @@ class DistServer:
                 continue
             frontier = np.asarray(blob["frontier"], np.int64)
             terms = np.asarray(blob["terms"], np.int64)
+            members = None
+            if "members" in blob:
+                members = np.asarray(blob["members"], bool)
             with self.lock:
                 if not (frontier >= self.applied).all():
                     log.info("dist[%d]: snapshot from %d does not "
                              "dominate; skipping", self.slot, h)
                     continue
-                inst = self.mr.install_snapshot(frontier, terms)
+                inst = self.mr.install_snapshot(frontier, terms,
+                                                members=members)
                 if not inst.any():
                     continue
                 self.store.recovery(blob["store"].encode())
@@ -817,6 +905,62 @@ class DistServer:
                 log.info("dist[%d]: installed snapshot from host %d "
                          "(%d lanes)", self.slot, h, int(inst.sum()))
             return
+
+    # -- runtime membership (server.go:382-404, 542-559, per host) --------
+
+    def add_member(self, slot: int,
+                   timeout: float | None = 30.0) -> None:
+        """Grow every group to include the host at member ``slot``
+        (its URL must already be in peer_urls — slots are pre-sized;
+        start the cluster with spare slots via ``live``).  One
+        ConfChange per group, committed under the OLD quorum."""
+        self._conf_change(True, slot, timeout)
+
+    def remove_member(self, slot: int,
+                      timeout: float | None = 30.0) -> None:
+        self._conf_change(False, slot, timeout)
+
+    def _conf_change(self, add: bool, slot: int,
+                     timeout: float | None) -> None:
+        """Each group's ConfChange goes through do() — which forwards
+        to THAT group's leader host like any write (leadership is
+        per-group and commonly split across hosts, so a local-queue-
+        only submission would commit on this host's lanes and drop
+        the rest, diverging per-group membership).  Groups run
+        concurrently; any failure raises after the sweep."""
+        if not (0 <= slot < self.m):
+            raise ValueError(
+                f"slot {slot} out of range ({self.m} member slots "
+                f"= len(peer_urls); start with spare URLs to grow)")
+        from concurrent.futures import ThreadPoolExecutor
+
+        payload = json.dumps({"add": bool(add), "slot": int(slot)})
+
+        def one(gi: int):
+            self.do(Request(method="CONFCHANGE", id=gen_id(),
+                            path=f"/_confchange/{gi}", val=payload),
+                    timeout=timeout)
+
+        with ThreadPoolExecutor(min(self.g, 16)) as pool:
+            futs = {gi: pool.submit(one, gi) for gi in range(self.g)}
+            failed = [gi for gi, f in futs.items()
+                      if f.exception() is not None]
+        if failed:
+            raise TimeoutError(
+                f"conf change uncommitted on {len(failed)} group(s) "
+                f"(e.g. {failed[:4]}): "
+                f"{futs[failed[0]].exception()}")
+
+    def _apply_conf_change(self, gi: int, r: Request) -> None:
+        d = json.loads(r.val)
+        mask = np.zeros(self.g, bool)
+        mask[gi] = True
+        self.mr.apply_conf_change(bool(d["add"]), int(d["slot"]),
+                                  mask=mask)
+
+    def members_of(self, gi: int) -> np.ndarray:
+        """[M] live-membership mask of group ``gi``."""
+        return np.asarray(self.mr.state.members)[gi]
 
     # -- RaftTimer --------------------------------------------------------
 

@@ -21,36 +21,11 @@ def rid() -> int:
     return _NEXT_ID[0]
 
 
-def free_ports(n):
-    import socket
-
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
+from conftest import bootstrap_dist_leader, make_dist_cluster
 
 
 def make_cluster(tmp_path, m=3, g=G, ports=None, **kw):
-    ports = ports or free_ports(m)
-    urls = [f"http://127.0.0.1:{p}" for p in ports]
-    servers = []
-    for s in range(m):
-        # election = 60 ticks (3s): first-round jit compiles and the
-        # shared-CPU test host push round latency past the production
-        # 0.5-1s window; the protocol is what's under test, not the
-        # timing margin
-        srv = DistServer(
-            str(tmp_path / f"d{s}"), slot=s, peer_urls=urls, g=g,
-            cap=64, tick_interval=0.05, post_timeout=2.0,
-            election=60, **kw)
-        srv.start()
-        servers.append(srv)
-    return servers, ports
+    return make_dist_cluster(tmp_path, m=m, g=g, ports=ports, **kw)
 
 
 def put(srv, key, val, timeout=10.0):
@@ -79,17 +54,7 @@ def wait_for(pred, timeout=15.0, msg="condition"):
 @pytest.fixture
 def cluster(tmp_path):
     servers, ports = make_cluster(tmp_path)
-    # bootstrap: host 0 campaigns for every group; races with peer
-    # timers can depose individual lanes, so converge on host 0
-    # holding every lane (re-campaign any lane it lost)
-    deadline = time.time() + 30.0
-    while time.time() < deadline:
-        lead = servers[0].mr.is_leader()
-        if lead.all():
-            break
-        servers[0]._campaign(~lead)
-        time.sleep(0.3)
-    assert servers[0].mr.is_leader().all(), "bootstrap election"
+    bootstrap_dist_leader(servers)
     yield servers, ports, tmp_path
     for s in servers:
         try:
@@ -254,3 +219,125 @@ def test_v2_http_api_serves_dist_cluster(cluster):
     finally:
         h0.shutdown()
         h1.shutdown()
+
+
+def test_dist_runtime_membership_grow(tmp_path):
+    """Distributed AddMember: a 4th host (pre-sized slot, live=3)
+    joins at runtime — the ConfChange commits under the old 2-of-3
+    quorum, the new member catches up by replication, and the new
+    4-member quorum (3) is reflected in every host's mask."""
+    servers, _ = make_dist_cluster(tmp_path, m=4, g=4, live=3)
+    try:
+        bootstrap_dist_leader(servers)
+        put(servers[0], "/dm/a", "1")
+        assert servers[0].members_of(0).sum() == 3
+
+        servers[0].add_member(3)
+        assert all(servers[0].members_of(gi).sum() == 4
+                   for gi in range(4))
+        # the joined member replicates (append path now includes it)
+        put(servers[0], "/dm/b", "2")
+        wait_for(lambda: get(servers[3],
+                             "/dm/b").event.node.value == "2",
+                 timeout=30.0, msg="new member catches up")
+        # every host converges on the 4-member mask via replication
+        wait_for(lambda: all(
+            s.members_of(0).sum() == 4 for s in servers),
+            timeout=30.0, msg="mask convergence")
+        # shrink back: quorum returns to 2-of-3
+        servers[0].remove_member(3)
+        assert all(servers[0].members_of(gi).sum() == 3
+                   for gi in range(4))
+        put(servers[0], "/dm/c", "3")
+    finally:
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+
+
+def test_dist_conf_change_with_split_leadership(tmp_path):
+    """The review scenario: leadership split across hosts — a
+    ConfChange for a group led elsewhere must FORWARD to that
+    group's leader (a local-only submission would commit on this
+    host's lanes and silently diverge per-group membership)."""
+    servers, _ = make_dist_cluster(tmp_path, m=4, g=4, live=3)
+    try:
+        bootstrap_dist_leader(servers)
+        # move two groups' leadership to host 1
+        mask = np.zeros(4, bool)
+        mask[:2] = True
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            if servers[1].mr.is_leader()[:2].all():
+                break
+            servers[1]._campaign(mask & ~servers[1].mr.is_leader())
+            time.sleep(0.3)
+        assert servers[1].mr.is_leader()[:2].all()
+        wait_for(lambda: servers[0].mr.is_leader()[2:].all(),
+                 msg="host 0 still leads groups 2-3")
+        # host 0 proposes the grow; groups 0-1 forward to host 1
+        servers[0].add_member(3)
+        wait_for(lambda: all(
+            s.members_of(gi).sum() == 4
+            for s in servers for gi in range(4)),
+            timeout=30.0, msg="uniform 4-member masks everywhere")
+    finally:
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+
+
+def test_ttl_expiry_replicates_to_followers(cluster):
+    """TTL expiry rides a replicated SYNC proposal (server.go:438-456
+    semantics): the key disappears from FOLLOWER replicas too, not
+    just the leader's store."""
+    from etcd_tpu.utils.errors import EtcdError
+
+    servers, _, _ = cluster
+    # TTL long enough that replication observably lands first (a
+    # too-short TTL races the first wait and flakes)
+    servers[0].do(Request(
+        method="PUT", id=rid(), path="/ttl/a", val="v",
+        expiration=int((time.time() + 3.0) * 1e9)), timeout=15)
+    wait_for(lambda: get(servers[1], "/ttl/a").event.node.value
+             == "v", msg="TTL key replicated")
+
+    def gone_everywhere():
+        for s in servers:
+            try:
+                s.store.get("/ttl/a", False, False)
+                return False
+            except EtcdError:
+                continue
+        return True
+    wait_for(gone_everywhere, timeout=30.0,
+             msg="TTL expiry on all replicas")
+
+
+def test_idle_sync_traffic_does_not_wedge_group0(tmp_path):
+    """Review regression: periodic replicated SYNCs must not fill
+    group 0's fixed-cap log lane on an idle cluster — lane-fill
+    compaction runs independently of the snap_count trigger."""
+    servers, _ = make_dist_cluster(tmp_path, m=3, g=4, cap=16,
+                                   sync_interval=0.02)
+    try:
+        bootstrap_dist_leader(servers)
+        # idle long enough for >> cap SYNC entries through group 0
+        time.sleep(3.0)
+        st = servers[0].mr.state
+        fill = int(np.asarray(st.last)[0] - np.asarray(st.offset)[0])
+        assert fill < 16, f"group 0 lane never compacted (fill={fill})"
+        # group 0 still accepts writes (no overflow wedge); /_etcd
+        # and /_confchange both hash/route into low groups
+        ev = put(servers[0], "/idle/k", "v", timeout=20.0)
+        assert ev.event.node.value == "v"
+    finally:
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
