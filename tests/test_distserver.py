@@ -21,7 +21,8 @@ def rid() -> int:
     return _NEXT_ID[0]
 
 
-from conftest import bootstrap_dist_leader, make_dist_cluster
+from conftest import bootstrap_dist_leader, free_ports as free_ports_n, \
+    make_dist_cluster
 
 
 def make_cluster(tmp_path, m=3, g=G, ports=None, **kw):
@@ -341,3 +342,45 @@ def test_idle_sync_traffic_does_not_wedge_group0(tmp_path):
                 s.stop()
             except Exception:
                 pass
+
+
+def test_ballot_survives_restart_no_double_vote(tmp_path):
+    """Vote durability (the HardState analog): a host that granted
+    its vote for term T must still refuse a competing candidate at
+    term T after a crash/restart — the ballot WAL record is the only
+    thing standing between this and a split-brain double grant."""
+    from etcd_tpu.wire.distmsg import VoteReq, unmarshal_any
+
+    ports = free_ports_n(3)
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    s = DistServer(str(tmp_path / "d0"), slot=0, peer_urls=urls,
+                   g=4, cap=64, election=60)
+    term5 = np.full(4, 5, np.int32)
+    req_a = VoteReq(sender=1, term=term5,
+                    last=np.zeros(4, np.int32),
+                    lterm=np.zeros(4, np.int32),
+                    active=np.ones(4, bool))
+    resp = unmarshal_any(s.handle_frame(req_a.marshal()))
+    assert resp.granted.all()
+    # a TRUE crash image: snapshot the data dir BEFORE any graceful
+    # shutdown flushes could mask a missing ballot fsync in the
+    # vote-response path itself
+    import shutil
+
+    shutil.copytree(str(tmp_path / "d0"), str(tmp_path / "crash"))
+    s.stop()
+
+    s2 = DistServer(str(tmp_path / "crash"), slot=0, peer_urls=urls,
+                    g=4, cap=64, election=60)
+    assert (np.asarray(s2.mr.state.term) == 5).all()
+    assert (np.asarray(s2.mr.state.vote) == 1).all()
+    req_b = VoteReq(sender=2, term=term5,
+                    last=np.ones(4, np.int32) * 9,
+                    lterm=np.ones(4, np.int32) * 9,
+                    active=np.ones(4, bool))
+    resp_b = unmarshal_any(s2.handle_frame(req_b.marshal()))
+    assert not resp_b.granted.any(), "double vote at the same term!"
+    # the SAME candidate re-asking is re-granted (idempotent)
+    resp_a2 = unmarshal_any(s2.handle_frame(req_a.marshal()))
+    assert resp_a2.granted.all()
+    s2.stop()
