@@ -451,6 +451,7 @@ class DistServer:
         msg = unmarshal_any(data)
         with self.lock:
             if isinstance(msg, AppendBatch):
+                self.server_stats.recv_append()
                 resp = self.mr.handle_append(msg)
                 recs = []
                 ok = resp.ok
@@ -673,6 +674,22 @@ class DistServer:
         mr = self.mr
         with self.lock:
             lead = mr.is_leader()
+            # /v2/stats/self role BEFORE any early return: followers
+            # and freshly-deposed leaders must update too (the early
+            # no-leader-lanes return below would otherwise freeze a
+            # deposed host on StateLeader forever).  Leadership is
+            # per-group; the scalar reference analog
+            # (server.py soft_state) maps to leader-of-any.
+            from ..raft.core import STATE_FOLLOWER, STATE_LEADER
+
+            lead_any = bool(lead.any())
+            hint = mr.leader_hint()
+            known = hint[hint >= 0]
+            self.server_stats.set_state(
+                STATE_LEADER if lead_any else STATE_FOLLOWER,
+                self.id if lead_any
+                else (int(np.bincount(known).argmax())
+                      if known.size else 0))
             n_new = np.zeros(self.g, np.int32)
             items: list[list[_Pending]] = [[] for _ in range(self.g)]
             for gi in range(self.g):
@@ -730,6 +747,8 @@ class DistServer:
         # would push round latency past follower election timeouts
         # (leadership flapping); a failed POST is simply a dropped
         # message pair
+        for _ in frames:
+            self.server_stats.send_append()
         resps = self._exchange(frames)
 
         with self.lock:

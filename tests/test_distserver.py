@@ -384,3 +384,37 @@ def test_ballot_survives_restart_no_double_vote(tmp_path):
     resp_a2 = unmarshal_any(s2.handle_frame(req_a.marshal()))
     assert resp_a2.granted.all()
     s2.stop()
+
+
+def test_stats_reflect_distributed_roles(cluster):
+    """/v2/stats/self parity: the bootstrap leader reports
+    StateLeader with append sends; followers report receives."""
+    servers, _, _ = cluster
+    put(servers[0], "/stats/k", "v")
+    wait_for(lambda: servers[0].server_stats.to_dict()["state"]
+             == "StateLeader", msg="leader state in stats")
+    d0 = servers[0].server_stats.to_dict()
+    assert d0["sendAppendRequestCnt"] > 0
+    wait_for(lambda: servers[1].server_stats.to_dict()[
+        "recvAppendRequestCnt"] > 0, msg="follower recv count")
+
+
+def test_stats_deposed_leader_becomes_follower(cluster):
+    """Review regression: a deposed leader's /v2/stats/self must drop
+    back to StateFollower (the no-leader-lanes early return must not
+    freeze the last reported role)."""
+    servers, _, _ = cluster
+    put(servers[0], "/dep/k", "v")
+    wait_for(lambda: servers[0].server_stats.to_dict()["state"]
+             == "StateLeader", msg="leader state")
+    # host 1 takes every group at a higher term
+    deadline = time.time() + 30.0
+    while time.time() < deadline:
+        if servers[1].mr.is_leader().all():
+            break
+        servers[1]._campaign(~servers[1].mr.is_leader())
+        time.sleep(0.3)
+    assert servers[1].mr.is_leader().all()
+    wait_for(lambda: servers[0].server_stats.to_dict()["state"]
+             == "StateFollower", timeout=30.0,
+             msg="deposed host reports follower")
