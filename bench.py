@@ -60,6 +60,7 @@ C3_SNAP_MB = int(os.environ.get("BENCH_C3_SNAP_MB", 256))
 C4_GROUPS = int(os.environ.get("BENCH_C4_GROUPS", 100_000))
 C4_ROUNDS = int(os.environ.get("BENCH_C4_ROUNDS", 30))
 C5_GROUPS = int(os.environ.get("BENCH_C5_GROUPS", 100_000))
+DIST_PROPOSALS = int(os.environ.get("BENCH_DIST_PROPOSALS", 2000))
 RESTART_ENTRIES = int(os.environ.get("BENCH_RESTART_ENTRIES",
                                      1_000_000))
 # Accelerator init can be slow behind a device tunnel; probe generously
@@ -415,6 +416,54 @@ def run_extra_configs(extra: dict, backend: str) -> None:
                 extra["config5"] = r
         except Exception as e:
             log(f"config5 failed: {e!r}")
+    if DIST_PROPOSALS:
+        try:
+            r = _run_json_subbench("dist_bench.py",
+                                   [str(DIST_PROPOSALS), "8"],
+                                   key="proposals_per_sec",
+                                   timeout=600)
+            if r is not None:
+                log(f"dist: {r['acked']} acked over 3 hosts at "
+                    f"{r['proposals_per_sec']}/s")
+                extra["dist_cluster"] = r
+        except Exception as e:
+            log(f"dist bench failed: {e!r}")
+
+
+def _run_json_subbench(script_name: str, argv: list[str], key: str,
+                       timeout: int,
+                       extra_env: dict | None = None) -> dict | None:
+    """Run a scripts/ sub-benchmark on the clean in-process CPU
+    backend and parse its JSON line (the shared runner behind config5
+    and the distributed-cluster bench).  ``extra_env`` entries whose
+    value already appears in the inherited variable are appended
+    rather than overwritten (an operator's XLA_FLAGS survive)."""
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", script_name)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    for k, v in (extra_env or {}).items():
+        cur = env.get(k, "")
+        if v not in cur:
+            env[k] = (cur + " " + v).strip()
+    try:
+        out = subprocess.run([sys.executable, script] + argv,
+                             capture_output=True, timeout=timeout,
+                             env=env, text=True)
+    except subprocess.TimeoutExpired:
+        log(f"{script_name} timed out")
+        return None
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            r = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(r, dict) and key in r:
+            return r
+    tail = out.stderr.strip().splitlines()
+    log(f"{script_name} rc={out.returncode}: "
+        f"{tail[-1] if tail else '?'}")
+    return None
 
 
 def bench_sharded_step(groups: int) -> dict | None:
@@ -424,35 +473,15 @@ def bench_sharded_step(groups: int) -> dict | None:
     CPU mesh in a subprocess (clean backend) and says so in its
     ``backend`` field — a measured wall time for the sharded step,
     not a TPU claim."""
-    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "scripts", "config5_bench.py")
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    flags = env.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        flags = (flags + " --xla_force_host_platform_device_count=8"
-                 ).strip()
-    env["XLA_FLAGS"] = flags
-    try:
-        out = subprocess.run(
-            [sys.executable, script, str(groups), "4"],
-            capture_output=True, timeout=600, env=env, text=True)
-    except subprocess.TimeoutExpired:
-        log("config5 subprocess timed out")
-        return None
-    for line in reversed(out.stdout.strip().splitlines()):
-        try:
-            r = json.loads(line)
-        except ValueError:
-            continue
-        if isinstance(r, dict) and "groups" in r:
-            log(f"config5: {r['groups']} groups sharded {r['mesh']}: "
-                f"{r['step_ms']}ms/step")
-            return r
-    tail = out.stderr.strip().splitlines()
-    log(f"config5 subprocess rc={out.returncode}: "
-        f"{tail[-1] if tail else '?'}")
-    return None
+    r = _run_json_subbench(
+        "config5_bench.py", [str(groups), "4"], key="step_ms",
+        timeout=600,
+        extra_env={"XLA_FLAGS":
+                   "--xla_force_host_platform_device_count=8"})
+    if r is not None:
+        log(f"config5: {r['groups']} groups sharded {r['mesh']}: "
+            f"{r['step_ms']}ms/step")
+    return r
 
 
 def measure_sustained(jax, rows, stored, iters):

@@ -148,6 +148,7 @@ class DistServer:
         self.cluster_store = ClusterStore(self.store)
         self._client_urls = client_urls or []
         self._queue: queue.Queue[_Pending | None] = queue.Queue()
+        self._slot_ids: dict[int, int] = {}  # slot -> member id cache
         self._requeue: list[deque] = [deque() for _ in range(g)]
         self._need_pull = False      # snapshot catch-up requested
         self._thread: threading.Thread | None = None
@@ -791,27 +792,59 @@ class DistServer:
                             gterm=int(terms[gi])).marshal()))
                 self._persist(recs)
 
-    def _exchange(self, frames: list[tuple[int, bytes]]) -> list:
+    def _exchange(self, frames: list[tuple[int, bytes]],
+                  track: bool = False) -> list:
         """POST one frame per peer concurrently; returns the parsed
         responses that arrived (drops parse failures and dead peers).
-        """
+        With ``track`` (the APPEND round only — vote traffic must not
+        skew follower stats, matching the reference's MSG_APP-only
+        tracking, sender.py), per-peer round-trip latency feeds
+        /v2/stats/leader keyed by member id."""
         if not frames:
             return []
         from concurrent.futures import ThreadPoolExecutor
 
         def one(arg):
             peer, payload = arg
+            t0 = time.perf_counter()
             out = self._post_peer(peer, "/mraft", payload)
             if out is None:
+                if track:
+                    self.leader_stats.fail(self._member_id(peer))
                 return None
             try:
-                return unmarshal_any(out)
+                parsed = unmarshal_any(out)
             except Exception:
+                if track:
+                    self.leader_stats.fail(self._member_id(peer))
                 return None
+            if track:
+                self.leader_stats.observe(
+                    self._member_id(peer),
+                    time.perf_counter() - t0)
+            return parsed
 
         with ThreadPoolExecutor(len(frames)) as pool:
             return [r for r in pool.map(one, frames)
                     if r is not None]
+
+    def _member_id(self, slot: int) -> int:
+        """Stats key for peer ``slot``: its registered member id when
+        the replicated registry has it (peers publish name->id with
+        their peer URL), else the slot index as a placeholder until
+        the registration commits."""
+        cached = self._slot_ids.get(slot)
+        if cached is not None:
+            return cached
+        try:
+            url = self.peer_urls[slot]
+            for m in self.cluster_store.get().values():
+                if url in m.peer_urls:
+                    self._slot_ids[slot] = m.id
+                    return m.id
+        except Exception:
+            pass
+        return slot
 
     def _post_peer(self, peer: int, path: str,
                    payload: bytes) -> bytes | None:

@@ -1,0 +1,108 @@
+"""Distributed-mode commit throughput: a REAL 3-host cluster on
+localhost HTTP (one member slot per host, server/distserver.py),
+client writes driven through the full path — propose → batched [G]
+frame to each peer → per-host fsync → quorum → apply → ack.
+
+Runs on the in-process CPU backend (the consensus math is a few tiny
+[G] ops per round; what this measures is the composed control plane +
+DCN tier, not device throughput) and says so in its backend field.
+
+Prints ONE JSON line:
+  JAX_PLATFORMS=cpu python scripts/dist_bench.py [PROPOSALS] [THREADS]
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    total = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
+    n_threads = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+
+    import socket
+
+    from etcd_tpu.server.distserver import DistServer
+    from etcd_tpu.server.server import gen_id
+    from etcd_tpu.wire.requests import Request
+
+    ports = []
+    for _ in range(3):
+        sk = socket.socket()
+        sk.bind(("127.0.0.1", 0))
+        ports.append(sk.getsockname()[1])
+        sk.close()
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    tmp = tempfile.mkdtemp()
+    servers = [DistServer(f"{tmp}/d{s}", slot=s, peer_urls=urls,
+                          g=64, cap=256, tick_interval=0.05,
+                          post_timeout=2.0, election=60)
+               for s in range(3)]
+    for s in servers:
+        s.start()
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        lead = servers[0].mr.is_leader()
+        if lead.all():
+            break
+        servers[0]._campaign(~lead)
+        time.sleep(0.3)
+    assert servers[0].mr.is_leader().all(), "bootstrap failed"
+
+    # distribute the remainder so exactly ``total`` are attempted
+    per = [total // n_threads + (1 if t < total % n_threads else 0)
+           for t in range(n_threads)]
+    acked = [0] * n_threads
+
+    def client(t):
+        for i in range(per[t]):
+            try:
+                servers[0].do(Request(
+                    method="PUT", id=gen_id(),
+                    path=f"/bench{t}/k{i}", val="v"), timeout=60)
+                acked[t] += 1
+            except TimeoutError:
+                pass
+
+    # warmup (compile the round path)
+    client0 = threading.Thread(target=lambda: servers[0].do(
+        Request(method="PUT", id=gen_id(), path="/warm/k", val="v"),
+        timeout=60))
+    client0.start()
+    client0.join()
+
+    t0 = time.perf_counter()
+    ts = [threading.Thread(target=client, args=(t,))
+          for t in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    dt = time.perf_counter() - t0
+    done = sum(acked)
+    for s in servers:
+        s.stop()
+    shutil.rmtree(tmp, ignore_errors=True)
+    print(json.dumps({
+        "hosts": 3, "groups": 64, "threads": n_threads,
+        "backend": "cpu-inprocess (control-plane measurement)",
+        "acked": done,
+        "proposals_per_sec": round(done / dt, 0),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
