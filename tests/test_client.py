@@ -1,0 +1,94 @@
+"""Python client library (api/client.py) — the reference's
+client/http_test.go coverage shape: action → request encoding,
+endpoint failover, error mapping, long-poll watch; driven against a
+live in-process server (the transport is real, like the TLS tests).
+"""
+
+import tempfile
+
+import pytest
+
+from conftest import free_ports
+from etcd_tpu.api.client import Client, ClientError
+from etcd_tpu.api.http import make_client_handler, serve
+from etcd_tpu.server.cluster import Cluster
+from etcd_tpu.server.server import ServerConfig, new_server
+
+
+@pytest.fixture(scope="module")
+def live():
+    port = free_ports(1)[0]
+    cluster = Cluster()
+    cluster.set_from_string("cl=http://127.0.0.1:1")
+    with tempfile.TemporaryDirectory() as d:
+        cfg = ServerConfig(name="cl", data_dir=d, cluster=cluster,
+                           client_urls=[f"http://127.0.0.1:{port}"])
+        srv = new_server(cfg)
+        srv.tick_interval = 0.01
+        srv.start()
+        httpd = serve(make_client_handler(srv), "127.0.0.1", port)
+        try:
+            yield port
+        finally:
+            httpd.shutdown()
+            srv.stop()
+
+
+def test_create_get_set_delete(live):
+    c = Client([f"http://127.0.0.1:{live}"])
+    out = c.create("/cli/a", "1")
+    assert out["action"] == "create"
+    assert out["node"]["value"] == "1"
+    # create on an existing key errors with the etcd code
+    with pytest.raises(ClientError) as ei:
+        c.create("/cli/a", "2")
+    assert ei.value.body["errorCode"] == 105  # node exist
+    out = c.set("/cli/a", "2")
+    assert out["action"] == "set"
+    out = c.get("/cli/a")
+    assert out["node"]["value"] == "2"
+    assert out["etcdIndex"] > 0  # header surfaced
+    out = c.delete("/cli/a")
+    assert out["action"] == "delete"
+    with pytest.raises(ClientError) as ei:
+        c.get("/cli/a")
+    assert ei.value.body["errorCode"] == 100  # key not found
+
+
+def test_recursive_sorted_get(live):
+    c = Client([f"http://127.0.0.1:{live}"])
+    c.set("/tree/b", "2")
+    c.set("/tree/a", "1")
+    out = c.get("/tree", recursive=True, sorted=True)
+    keys = [n["key"] for n in out["node"]["nodes"]]
+    assert keys == sorted(keys)
+
+
+def test_endpoint_failover_skips_dead_hosts(live):
+    """First endpoint refuses connections; the client falls through
+    to the live one (client.go's endpoint iteration)."""
+    dead_port = free_ports(1)[0]  # nothing listens here
+    c = Client([f"http://127.0.0.1:{dead_port}",
+                f"http://127.0.0.1:{live}"])
+    out = c.set("/fo/k", "v")
+    assert out["node"]["value"] == "v"
+
+
+def test_all_endpoints_dead_raises_transport_error(live):
+    dead_port = free_ports(1)[0]
+    c = Client([f"http://127.0.0.1:{dead_port}"], timeout=1.0)
+    with pytest.raises(OSError):
+        c.get("/whatever")
+
+
+def test_watch_long_poll(live):
+    """Deterministic ordering: watch from the index AFTER v0's
+    modifiedIndex, write v1, then long-poll — the event-history
+    catch-up (event_history.go:44 semantics) hands the event over
+    regardless of registration/write interleaving."""
+    c = Client([f"http://127.0.0.1:{live}"])
+    out = c.set("/wl/k", "v0")
+    idx = out["node"]["modifiedIndex"]
+    c.set("/wl/k", "v1")
+    got = c.watch("/wl/k", wait_index=idx + 1, timeout=30)
+    assert got["node"]["value"] == "v1"

@@ -418,3 +418,17 @@ def test_stats_deposed_leader_becomes_follower(cluster):
     wait_for(lambda: servers[0].server_stats.to_dict()["state"]
              == "StateFollower", timeout=30.0,
              msg="deposed host reports follower")
+
+
+def test_watch_fires_on_follower_replica(cluster):
+    """Watches registered on a FOLLOWER's replica fire when
+    replication applies the committed write there — the wait=true
+    long-poll works against any host."""
+    servers, _, _ = cluster
+    wc = servers[1].do(Request(id=rid(), method="GET",
+                               path="/wf/key", wait=True)).watcher
+    put(servers[0], "/wf/key", "fired")
+    # watcher events buffer from registration; drain inline
+    ev = wc.next_event(timeout=30)
+    assert ev is not None and ev.action == "set"
+    assert ev.node.value == "fired"
