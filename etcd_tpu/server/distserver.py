@@ -53,8 +53,6 @@ from ..utils.wait import Wait
 from ..wal import WAL, exist as wal_exist
 from ..wire import Entry, GroupEntry, HardState, Snapshot
 from ..wire.distmsg import (
-    KIND_APPEND,
-    KIND_VOTE,
     AppendBatch,
     AppendResp,
     VoteReq,
@@ -68,7 +66,6 @@ from .server import (
     Response,
     ServerStoppedError,
     UnknownMethodError,
-    _replay_wal,
     apply_request_to_store,
     gen_id,
 )
