@@ -245,3 +245,74 @@ def test_dist_frames_match_fused_multiraft():
             # committed payloads agree too
             assert (fused.committed_payload(gi, idx) or b"") == \
                 (dist[0].committed_payload(gi, idx) or b"")
+
+
+def test_randomized_lossy_exchange_log_matching():
+    """Fuzz the frame layer the way the reference fuzzes its fake
+    network (raft_test.go lossy topologies): random proposals,
+    per-edge drops, competing campaigns, compactions — then assert
+    the Log Matching safety property: every pair of members agrees
+    on term AND payload for every index at or below both commits
+    (above both offsets)."""
+    rng = np.random.default_rng(1234)
+    g, m, cap = 4, 3, 96
+    ms = make_cluster(g=g, m=m, cap=cap)
+    elect(ms, 0)
+    ms[0].propose(np.ones(g, np.int32), data=[[b""]] * g)
+
+    def rand_drop():
+        if rng.random() < 0.5:
+            return set()
+        return set(rng.choice(m, size=rng.integers(1, m),
+                              replace=False).tolist())
+
+    leader = 0
+    for step in range(120):
+        act = rng.random()
+        if act < 0.55:
+            n = rng.integers(0, 3, size=g).astype(np.int32)
+            data = [[bytes([step % 256, j]) for j in range(int(n[gi]))]
+                    for gi in range(g)]
+            ms[leader].propose(n, data=data)
+            replicate(ms, leader, drop=rand_drop() - {leader})
+        elif act < 0.75:
+            replicate(ms, leader, drop=rand_drop() - {leader})
+        elif act < 0.9:
+            # competing campaign from a random member; on a win it
+            # proposes its becoming-leader entry
+            cand = int(rng.integers(0, m))
+            won = elect(ms, cand)
+            if won.any():
+                leader = cand
+                ms[cand].propose(
+                    won.astype(np.int32),
+                    data=[[b"L"] if won[gi] else []
+                          for gi in range(g)])
+        else:
+            slot = int(rng.integers(0, m))
+            ms[slot].mark_applied(ms[slot].commit_index())
+            ms[slot].compact()
+
+    # settle: several clean rounds so commits converge
+    for _ in range(6):
+        replicate(ms, leader)
+
+    for a in range(m):
+        for b in range(a + 1, m):
+            ca, cb = ms[a].commit_index(), ms[b].commit_index()
+            oa = np.asarray(ms[a].state.offset)
+            ob = np.asarray(ms[b].state.offset)
+            for gi in range(g):
+                lo = int(max(oa[gi], ob[gi])) + 1
+                hi = int(min(ca[gi], cb[gi]))
+                for idx in range(lo, hi + 1):
+                    v = np.full(g, idx)
+                    ta = int(ms[a].terms_at(v)[gi])
+                    tb = int(ms[b].terms_at(v)[gi])
+                    assert ta == tb, (
+                        f"term divergence g{gi}@{idx}: "
+                        f"m{a}={ta} m{b}={tb}")
+                    pa = ms[a].committed_payload(gi, idx)
+                    pb = ms[b].committed_payload(gi, idx)
+                    if pa is not None and pb is not None:
+                        assert pa == pb, (gi, idx, pa, pb)
