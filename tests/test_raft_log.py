@@ -1,0 +1,174 @@
+"""RaftLog unit tests translated from reference raft/log_test.go.
+
+Each test mirrors the reference table test of the same name
+(/root/reference/raft/log_test.go); the reference's panics surface
+here as LogError (raft/log.py docstring).
+"""
+
+import pytest
+
+from etcd_tpu.raft.log import LogError, RaftLog
+from etcd_tpu.wire import Entry, Snapshot
+
+
+def _log_with(ents, offset=0, unstable=None):
+    lg = RaftLog()
+    lg.ents = list(ents)
+    lg.offset = offset
+    if unstable is not None:
+        lg.unstable = unstable
+    return lg
+
+
+# reference log_test.go:15 TestAppend
+@pytest.mark.parametrize(
+    "after,ents,windex,wents,wunstable",
+    [
+        (2, [], 2, [Entry(term=1), Entry(term=2)], 3),
+        (2, [Entry(term=2)], 3,
+         [Entry(term=1), Entry(term=2), Entry(term=2)], 3),
+        # conflicts with index 1
+        (0, [Entry(term=2)], 1, [Entry(term=2)], 1),
+        # conflicts with index 2
+        (1, [Entry(term=3), Entry(term=3)], 3,
+         [Entry(term=1), Entry(term=3), Entry(term=3)], 2),
+    ],
+)
+def test_append(after, ents, windex, wents, wunstable):
+    lg = _log_with([Entry(), Entry(term=1), Entry(term=2)], unstable=3)
+    assert lg.append(after, ents) == windex
+    assert lg.entries(1) == wents
+    assert lg.unstable == wunstable
+
+
+# reference log_test.go:76 TestCompactionSideEffects
+def test_compaction_side_effects():
+    last_index = 1000
+    lg = RaftLog()
+    for i in range(last_index):
+        lg.append(i, [Entry(term=i + 1, index=i + 1)])
+    lg.maybe_commit(last_index, last_index)
+    lg.reset_next_ents()
+
+    lg.compact(500)
+    assert lg.last_index() == last_index
+    for i in range(lg.offset, lg.last_index() + 1):
+        assert lg.term(i) == i
+        assert lg.match_term(i, i)
+
+    unstable = lg.unstable_ents()
+    assert len(unstable) == 500
+    assert unstable[0].index == 501
+
+    prev = lg.last_index()
+    lg.append(prev, [Entry(term=prev + 1)])
+    assert lg.last_index() == prev + 1
+    assert len(lg.entries(lg.last_index())) == 1
+
+
+# reference log_test.go:126 TestUnstableEnts
+@pytest.mark.parametrize(
+    "unstable,wents,wunstable",
+    [
+        (3, [], 3),
+        (1, [Entry(term=1, index=1), Entry(term=2, index=2)], 3),
+    ],
+)
+def test_unstable_ents(unstable, wents, wunstable):
+    prev = [Entry(term=1, index=1), Entry(term=2, index=2)]
+    lg = _log_with([Entry()] + prev, unstable=unstable)
+    ents = lg.unstable_ents()
+    lg.reset_unstable()
+    assert ents == wents
+    assert lg.unstable == wunstable
+
+
+# reference log_test.go:153 TestCompaction
+@pytest.mark.parametrize(
+    "applied,last_index,compacts,wleft,wallow",
+    [
+        # out of upper bound
+        (1000, 1000, [1001], [-1], False),
+        (1000, 1000, [300, 500, 800, 900], [701, 501, 201, 101], True),
+        # out of lower bound
+        (1000, 1000, [300, 299], [701, -1], False),
+        (0, 1000, [1], [-1], False),
+    ],
+)
+def test_compaction(applied, last_index, compacts, wleft, wallow):
+    lg = RaftLog()
+    for i in range(last_index):
+        lg.append(i, [Entry()])
+    lg.maybe_commit(applied, 0)
+    lg.reset_next_ents()
+
+    raised = False
+    for j, ci in enumerate(compacts):
+        try:
+            lg.compact(ci)
+        except LogError:
+            raised = True
+            break
+        assert len(lg.ents) == wleft[j]
+    assert raised != wallow
+
+
+# reference log_test.go:196 TestLogRestore
+def test_log_restore():
+    lg = RaftLog()
+    for i in range(100):
+        lg.append(i, [Entry(term=i + 1)])
+
+    index, term = 1000, 1000
+    lg.restore(Snapshot(index=index, term=term))
+
+    assert len(lg.ents) == 1  # only the guard entry
+    assert lg.offset == index
+    assert lg.applied == index
+    assert lg.committed == index
+    assert lg.unstable == index + 1
+    assert lg.term(index) == term
+
+
+# reference log_test.go:228 TestIsOutOfBounds
+@pytest.mark.parametrize(
+    "index,w",
+    [(99, True), (100, False), (150, False), (199, False), (200, True)],
+)
+def test_is_out_of_bounds(index, w):
+    lg = _log_with([Entry() for _ in range(100)], offset=100)
+    assert lg._is_out_of_bounds(index) == w
+
+
+# reference log_test.go:252 TestAt
+@pytest.mark.parametrize(
+    "index,w",
+    [
+        (99, None),
+        (100, Entry(term=0)),
+        (150, Entry(term=50)),
+        (199, Entry(term=99)),
+        (200, None),
+    ],
+)
+def test_at(index, w):
+    lg = _log_with([Entry(term=i) for i in range(100)], offset=100)
+    assert lg.at(index) == w
+
+
+# reference log_test.go:281 TestSlice
+@pytest.mark.parametrize(
+    "lo,hi,w",
+    [
+        (99, 101, []),
+        (100, 101, [Entry(term=0)]),
+        (150, 151, [Entry(term=50)]),
+        (199, 200, [Entry(term=99)]),
+        (200, 201, []),
+        (150, 150, []),
+        (150, 149, []),
+    ],
+)
+def test_slice(lo, hi, w):
+    lg = _log_with([Entry(term=i) for i in range(100)], offset=100)
+    assert lg.slice(lo, hi) == w
