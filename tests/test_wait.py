@@ -1,0 +1,46 @@
+"""Wait registry tests translated from reference wait/wait_test.go."""
+
+import queue
+
+import pytest
+
+from etcd_tpu.utils.wait import Wait
+
+
+# reference wait_test.go:8 TestWait
+def test_wait():
+    wt = Wait()
+    ch = wt.register(1)
+    wt.trigger(1, "foo")
+    assert ch.get(timeout=1) == "foo"
+    # the Go channel is closed after trigger: a second receive
+    # returns the zero value immediately instead of blocking
+    assert ch.get(timeout=0) is None
+
+
+# reference wait_test.go:23 TestRegisterDupSuppression
+def test_register_dup_suppression():
+    wt = Wait()
+    ch1 = wt.register(1)
+    ch2 = wt.register(1)
+    assert ch1 is ch2  # dup register returns the same channel
+    wt.trigger(1, "foo")
+    assert ch1.get(timeout=1) == "foo"
+    assert ch2.get(timeout=0) is None
+
+
+# reference wait_test.go:36 TestTriggerDupSuppression
+def test_trigger_dup_suppression():
+    wt = Wait()
+    ch = wt.register(1)
+    wt.trigger(1, "foo")
+    wt.trigger(1, "bar")  # second trigger finds no registration
+    assert ch.get(timeout=1) == "foo"
+    assert ch.get(timeout=0) is None
+
+
+def test_get_timeout_raises_empty():
+    wt = Wait()
+    ch = wt.register(1)
+    with pytest.raises(queue.Empty):
+        ch.get(timeout=0.01)
