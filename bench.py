@@ -42,8 +42,10 @@ BENCH_THREADS (default min(16, cpus)).
 
 import json
 import os
+import shutil
 import subprocess
 import sys
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
@@ -71,26 +73,98 @@ RESTART_ENTRIES = int(os.environ.get("BENCH_RESTART_ENTRIES",
 BACKEND_TIMEOUT = int(os.environ.get("BENCH_BACKEND_TIMEOUT", 600))
 # Sustained-throughput passes for the device-resident measurement.
 SUSTAIN_ITERS = int(os.environ.get("BENCH_SUSTAIN_ITERS", 8))
+# Whole-run deadline: a degraded tunnel can stall any single device
+# call indefinitely (compiles observed from 45s to >25min on the same
+# graph across sessions); past this budget the watchdog emits the
+# best measurement gathered so far instead of hanging the driver.
+DEADLINE = int(os.environ.get("BENCH_DEADLINE", 2400))
+# Per-stage budget for any single device-touching stage.  A stage that
+# exceeds it is abandoned (its worker thread is left blocked — never
+# kill a process holding a live tunnel session) and all later
+# device-touching stages are skipped, since their dispatches would
+# queue behind the stalled call on the same PJRT client.
+DEVICE_TIMEOUT = int(os.environ.get("BENCH_DEVICE_TIMEOUT", 600))
+
+_T0 = time.monotonic()
+
+
+def _stage_budget(want: int) -> int:
+    """Clamp a stage budget to what remains before the deadline,
+    keeping 120s of slack for the host-side stages after it."""
+    left = DEADLINE - (time.monotonic() - _T0) - 120
+    return max(30, min(want, int(left)))
+
+
+def bounded(label: str, fn, timeout: int):
+    """Run ``fn()`` on a worker thread with a join timeout.
+
+    Returns ``(status, value)``: ``("ok", result)``, ``("error", e)``,
+    or ``("stalled", None)`` if the call did not return in time — in
+    which case the daemon worker is abandoned mid-call (the safe
+    option for a wedged tunnel; see PALLAS_NOTES.md).
+    """
+    out = {}
+
+    def work():
+        try:
+            out["r"] = fn()
+        except BaseException as e:  # noqa: BLE001 - report, don't die
+            out["e"] = e
+
+    th = threading.Thread(target=work, daemon=True, name=label)
+    th.start()
+    th.join(timeout)
+    if th.is_alive():
+        log(f"{label}: no response in {timeout}s; abandoning stage")
+        return "stalled", None
+    if "e" in out:
+        return "error", out["e"]
+    return "ok", out["r"]
+
 
 _METRIC = "wal_replay_entries_per_sec_chip"
 _emitted = False
+# Temp dirs created inside bounded stages: an abandoned (stalled)
+# stage thread never reaches its finally/rmtree, so the parent sweeps
+# these best-effort after a stall verdict and before watchdog exit.
+_tmp_paths: list = []
+
+
+def _sweep_tmp():
+    for p in list(_tmp_paths):
+        shutil.rmtree(p, ignore_errors=True)
+
+
+# Best-so-far state the deadline watchdog can emit: updated at every
+# milestone (baseline done, e2e done, sustained done, each config).
+_partial = {"value": 0.0, "vs": 0.0, "extra": {}}
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+_emit_lock = threading.Lock()
+
+
 def emit(value, vs_baseline, **extra):
-    """Print the ONE required JSON line (guarded against double-emit)."""
+    """Print the ONE required JSON line (guarded against double-emit;
+    the deadline watchdog thread may race the main thread here).
+
+    The print stays INSIDE the lock: the watchdog os._exits right
+    after its emit() returns, so the main thread's line must be fully
+    written before a racing watchdog call can observe _emitted and
+    proceed to the exit."""
     global _emitted
-    if _emitted:
-        return
-    _emitted = True
-    line = {"metric": _METRIC, "value": round(float(value), 1),
-            "unit": "entries/s",
-            "vs_baseline": round(float(vs_baseline), 3)}
-    line.update(extra)
-    print(json.dumps(line), flush=True)
+    with _emit_lock:
+        if _emitted:
+            return
+        _emitted = True
+        line = {"metric": _METRIC, "value": round(float(value), 1),
+                "unit": "entries/s",
+                "vs_baseline": round(float(vs_baseline), 3)}
+        line.update(extra)
+        print(json.dumps(line), flush=True)
 
 
 def select_backend():
@@ -167,7 +241,6 @@ def select_backend():
     # The probe passing doesn't guarantee the parent's own init won't
     # hit an intermittent tunnel hang (TOCTOU); a watchdog converts a
     # post-probe hang into an emitted error line + nonzero exit.
-    import threading
     done = threading.Event()
 
     def watchdog():
@@ -228,14 +301,17 @@ def bench_snapshot(mb: int, backend: str) -> dict | None:
     rng = np.random.default_rng(7)
     blob = rng.integers(0, 256, size=mb << 20, dtype=np.uint8).tobytes()
     out = {}
-    for mode in (backend, "host"):
+    # dedupe: a host-only caller must not time the host row twice
+    for mode in dict.fromkeys((backend, "host")):
         crc_fn = None
         if mode != "host":
             from etcd_tpu.ops.crc_kernel import auto_crc32c
 
             crc_fn = auto_crc32c
             auto_crc32c(blob[: 8 << 20])  # compile warmup
-        with tempfile.TemporaryDirectory() as d:
+        d = tempfile.mkdtemp()
+        _tmp_paths.append(d)  # swept by parent if this stage stalls
+        try:
             ss = Snapshotter(d, crc_fn=crc_fn)
             t0 = time.perf_counter()
             ss.save_snap(Snapshot(data=blob, index=1, term=1))
@@ -244,6 +320,8 @@ def bench_snapshot(mb: int, backend: str) -> dict | None:
             got = ss.load()
             t_load = time.perf_counter() - t0
             assert got.data == blob
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
         out[mode] = (mb / t_save, mb / t_load)
         log(f"config3[{mode}]: save {mb}MB @ {mb / t_save:.0f} MB/s, "
             f"load @ {mb / t_load:.0f} MB/s")
@@ -306,7 +384,6 @@ def bench_restart(n: int, g: int = 64, window: int = 10_000) -> dict:
     the restart, dominated by the replay parse the array lane
     (server/gereplay.py + native ge_scan) accelerates."""
     import hashlib
-    import shutil
     import tempfile
 
     from etcd_tpu.server.multigroup import MultiGroupServer
@@ -317,6 +394,7 @@ def bench_restart(n: int, g: int = 64, window: int = 10_000) -> dict:
     from etcd_tpu.wire.requests import Info, Request
 
     d = tempfile.mkdtemp()
+    _tmp_paths.append(d)  # swept by parent if this stage stalls
     try:
         name = "multigroup"
         sid = int.from_bytes(
@@ -381,34 +459,81 @@ def bench_restart(n: int, g: int = 64, window: int = 10_000) -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
-def run_extra_configs(extra: dict, backend: str) -> None:
-    """Configs 2-4; failures degrade to logged errors, never kill the
-    primary metric emission."""
-    if C2_PROPOSALS:
-        try:
-            r = bench_cluster_commits(C2_PROPOSALS)
-            extra["config2_proposals_per_sec"] = round(r, 0)
-        except Exception as e:
-            log(f"config2 failed: {e!r}")
+def run_extra_configs(extra: dict, backend: str,
+                      device_ok: bool = True) -> None:
+    """Configs 2-5 + restart + dist; failures degrade to logged
+    errors, never kill the primary metric emission.
+
+    ``device_ok=False`` means an earlier device stage stalled: every
+    in-process stage that would dispatch to the device (configs 2/4,
+    the config-3 device row, the multigroup restart whose engine is
+    device-backed) is skipped — its dispatches would queue behind the
+    stalled call — while host rows and clean-subprocess stages
+    (config 5, dist) still run.
+    """
+    run_device = device_ok or backend == "cpu"
+
+    def note_skip(name):
+        extra.setdefault("skipped_on_stall", []).append(name)
+        log(f"tunnel stalled: skipping {name}")
+
+    def device_stage(name, on, fn):
+        """Run one device-touching stage under a stall budget.
+
+        A stall marks the tunnel bad for every later device stage
+        (their dispatches would queue behind the stalled call); an
+        exception only loses this stage.  Returns the stage result or
+        None."""
+        nonlocal run_device
+        if not on:
+            return None
+        if not run_device:
+            note_skip(name)
+            return None
+        st, r = bounded(name, fn, _stage_budget(DEVICE_TIMEOUT))
+        if st == "ok":
+            return r
+        if st == "error":
+            log(f"{name} failed: {r!r}")
+        else:
+            run_device = False
+            note_skip(name)
+            _sweep_tmp()
+        return None
+
+    r = device_stage("config2", C2_PROPOSALS,
+                     lambda: bench_cluster_commits(C2_PROPOSALS))
+    if r is not None:
+        extra["config2_proposals_per_sec"] = round(r, 0)
     if C3_SNAP_MB:
-        try:
-            r = bench_snapshot(C3_SNAP_MB, backend)
+        # config3 degrades to its host-only row rather than skipping
+        mode = backend if run_device else "host"
+        st, r = bounded("config3",
+                        lambda: bench_snapshot(C3_SNAP_MB, mode),
+                        _stage_budget(DEVICE_TIMEOUT))
+        if st == "ok":
             extra["config3_snapshot_save_mbps"] = {
                 k: round(v[0], 0) for k, v in r.items()}
             extra["config3_snapshot_load_mbps"] = {
                 k: round(v[1], 0) for k, v in r.items()}
-        except Exception as e:
-            log(f"config3 failed: {e!r}")
-    if C4_GROUPS:
-        try:
-            extra["config4"] = bench_group_latency(C4_GROUPS, C4_ROUNDS)
-        except Exception as e:
-            log(f"config4 failed: {e!r}")
-    if RESTART_ENTRIES:
-        try:
-            extra["restart_replay"] = bench_restart(RESTART_ENTRIES)
-        except Exception as e:
-            log(f"restart bench failed: {e!r}")
+        elif st == "error":
+            log(f"config3 failed: {r!r}")
+        else:
+            # Only condemn the tunnel if the device row was in play;
+            # a host-only row stalling is a disk problem, not a
+            # tunnel problem.
+            if mode != "host":
+                run_device = False
+            note_skip("config3")
+            _sweep_tmp()
+    r = device_stage("config4", C4_GROUPS,
+                     lambda: bench_group_latency(C4_GROUPS, C4_ROUNDS))
+    if r is not None:
+        extra["config4"] = r
+    r = device_stage("restart_replay", RESTART_ENTRIES,
+                     lambda: bench_restart(RESTART_ENTRIES))
+    if r is not None:
+        extra["restart_replay"] = r
     if C5_GROUPS:
         try:
             r = bench_sharded_step(C5_GROUPS)
@@ -576,8 +701,45 @@ def probe_env_ceiling(jax) -> float | None:
         return None
 
 
+def start_deadline_watchdog():
+    """Emit the best-so-far JSON and exit if the run exceeds DEADLINE.
+
+    A wedged tunnel blocks inside a device call where no exception can
+    reach it (PALLAS_NOTES.md "Operational hazard"); the only way to
+    guarantee the driver gets its JSON line is a hard exit from a
+    watchdog thread.  The exit may orphan the tunnel session — worth
+    it: an emitted partial number beats a silent hang (round-1 failure
+    mode was rc=1 with no line at all).
+    """
+
+    def fire():
+        try:
+            log(f"bench deadline {DEADLINE}s hit; emitting partials")
+            # The main thread mutates the extra dict concurrently; a
+            # failed snapshot must still produce SOME line (finally).
+            try:
+                p = dict(_partial["extra"])
+            except RuntimeError:  # dict changed size during iteration
+                p = {}
+            p["deadline_hit"] = DEADLINE
+            emit(_partial["value"], _partial["vs"], **p)
+            sys.stdout.flush()
+            _sweep_tmp()
+        finally:
+            # rc 0: the line IS the deliverable and carries
+            # deadline_hit; a nonzero rc could make a driver discard
+            # the parsed JSON.
+            os._exit(0)
+
+    t = threading.Timer(DEADLINE, fire)
+    t.daemon = True
+    t.start()
+
+
 def main():
     from etcd_tpu import native
+
+    start_deadline_watchdog()
 
     if not native.available():
         log("native toolchain unavailable; cannot measure baseline")
@@ -659,47 +821,96 @@ def main():
         assert n_ok == rows.shape[0], (n_ok, rows.shape[0])
         return n_ok
 
+    extra = {"backend": backend, "probe": probe_info}
+    device_ok = True
     with ThreadPoolExecutor(THREADS) as pool:
         t0 = time.perf_counter()
         batch = assemble(pool)
         host_s = time.perf_counter() - t0
         log(f"host scan+pad: {host_s:.2f}s")
-        log("compiling device path (warmup) ...")
-        t0 = time.perf_counter()
-        device_verify(batch)
-        log(f"  warmup {time.perf_counter() - t0:.2f}s")
 
-        t0 = time.perf_counter()
-        batch = assemble(pool)
-        nrec = device_verify(batch)
-        e2e_s = time.perf_counter() - t0
+        def e2e_run():
+            log("compiling device path (warmup) ...")
+            t0 = time.perf_counter()
+            device_verify(batch)
+            log(f"  warmup {time.perf_counter() - t0:.2f}s")
+            b2 = assemble(pool)
+            t0 = time.perf_counter()
+            n = device_verify(b2)
+            return b2, time.perf_counter() - t0, n
 
-    e2e_eps = total_entries / e2e_s
-    log(f"e2e pipeline (host scan + H2D + device verify): {e2e_s:.3f}s "
-        f"= {e2e_eps / 1e6:.2f}M entries/s ({nrec} records verified)")
+        st, r = bounded("e2e device verify", e2e_run,
+                        _stage_budget(DEVICE_TIMEOUT))
+    if st == "ok":
+        batch, e2e_s, nrec = r
+        e2e_eps = total_entries / e2e_s
+        log(f"e2e pipeline (host scan + H2D + device verify): "
+            f"{e2e_s:.3f}s = {e2e_eps / 1e6:.2f}M entries/s "
+            f"({nrec} records verified)")
+    elif st == "stalled":
+        # Only a STALL condemns the tunnel; an exception means the
+        # device answered and later stages may still succeed.
+        device_ok = False
+        e2e_eps = 0.0
+        extra["e2e"] = f"stalled > {DEVICE_TIMEOUT}s"
+        log("e2e device stage stalled; "
+            "device-touching configs will be skipped")
+    else:
+        e2e_eps = 0.0
+        extra["e2e"] = f"error: {r!r}"[:200]
+        log(f"e2e device stage failed: {r!r}")
 
-    # Sustained on-chip throughput with the batch HBM-resident: what
-    # the chip itself does per second once fed (see measure_sustained
-    # docstring for why this is separated from the tunnel-bound e2e).
-    sus_eps = None
-    if not degraded:
-        try:
-            sus_eps, n_ok = measure_sustained(jax, batch[0], batch[1],
-                                              iters=SUSTAIN_ITERS)
-            assert n_ok == total_entries, (n_ok, total_entries)
-            log(f"device-sustained: {sus_eps / 1e6:.2f}M entries/s "
-                f"({SUSTAIN_ITERS} resident passes, raw CRC + chain "
-                f"verify, single scalar sync)")
-        except Exception as e:
-            sus_eps = None  # a failed gate must not promote a number
-            log(f"sustained measurement failed: {e!r}")
-
-    extra = {"backend": backend, "probe": probe_info}
     if degraded:
         # An honest chip metric requires a chip; a cpu-fallback number
         # is still emitted (value > 0) but unmistakably marked.
         extra["degraded"] = True
     value, vs = e2e_eps, e2e_eps / base_eps
+    # From here on the watchdog can emit a labeled partial result.
+    _partial.update(value=value, vs=vs, extra=extra)
+
+    if not degraded and device_ok:
+        # Ceiling first: it is one small compile, and it must land in
+        # the JSON even if the (much bigger) sustained graph stalls on
+        # a degraded tunnel session.
+        st, tflops = bounded("env ceiling probe",
+                             lambda: probe_env_ceiling(jax),
+                             _stage_budget(DEVICE_TIMEOUT // 2))
+        if st == "stalled":
+            device_ok = False
+            extra["env_ceiling"] = "stalled"
+        elif st == "ok" and tflops is not None:
+            log(f"env dense-matmul ceiling: {tflops:.2f} TFLOPS bf16 "
+                f"(v5e spec ~197)")
+            extra["env_matmul_tflops_bf16"] = round(tflops, 2)
+            extra["v5e_spec_tflops_bf16"] = 197
+
+    # Sustained on-chip throughput with the batch HBM-resident: what
+    # the chip itself does per second once fed (see measure_sustained
+    # docstring for why this is separated from the tunnel-bound e2e).
+    sus_eps = None
+    if not degraded and device_ok:
+        st, r = bounded(
+            "sustained measurement",
+            lambda: measure_sustained(jax, batch[0], batch[1],
+                                      iters=SUSTAIN_ITERS),
+            _stage_budget(DEVICE_TIMEOUT))
+        if st == "stalled":
+            device_ok = False
+            extra["sustained"] = f"stalled > {DEVICE_TIMEOUT}s"
+        elif st == "error":
+            log(f"sustained measurement failed: {r!r}")
+        else:
+            sus_eps, n_ok = r
+            if n_ok != total_entries:
+                # a failed gate must not promote a number — keep the
+                # valid e2e measurement instead of dying here
+                log(f"sustained gate mismatch: {n_ok} != "
+                    f"{total_entries}; discarding sustained number")
+                sus_eps = None
+            else:
+                log(f"device-sustained: {sus_eps / 1e6:.2f}M "
+                    f"entries/s ({SUSTAIN_ITERS} resident passes, "
+                    f"raw CRC + chain verify, single scalar sync)")
     if sus_eps is not None:
         # Primary value: the chip's sustained rate.  The e2e number
         # rides the harness's device tunnel (~0.5 GB/s H2D, ~65 ms
@@ -712,13 +923,8 @@ def main():
         extra["e2e_vs_baseline"] = round(e2e_eps / base_eps, 3)
         extra["transport"] = "axon loopback tunnel (~0.5 GB/s H2D, "\
             "~16 MB/s D2H, ~65 ms/dispatch — harness artifact)"
-        tflops = probe_env_ceiling(jax)
-        if tflops is not None:
-            log(f"env dense-matmul ceiling: {tflops:.2f} TFLOPS bf16 "
-                f"(v5e spec ~197)")
-            extra["env_matmul_tflops_bf16"] = round(tflops, 2)
-            extra["v5e_spec_tflops_bf16"] = 197
-    run_extra_configs(extra, backend)
+        _partial.update(value=value, vs=vs)
+    run_extra_configs(extra, backend, device_ok)
     emit(value, vs, **extra)
 
 

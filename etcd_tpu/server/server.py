@@ -299,8 +299,13 @@ class EtcdServer:
             except _q.Empty:
                 self.w.trigger(r.id, None)  # GC wait
                 raise TimeoutError("request timed out")
-            if self.done.is_set() and x is None:
-                raise ServerStoppedError()
+            if x is None:
+                # stop, a GC'd registration, or a duplicate request
+                # id whose channel was already consumed (Chan is
+                # one-shot: later receivers observe closure)
+                if self.done.is_set():
+                    raise ServerStoppedError()
+                raise TimeoutError("request superseded")
             resp = x
             if resp.err is not None:
                 raise resp.err
