@@ -193,10 +193,12 @@ def select_backend():
     # Fast preflight: under a loopback device relay (this harness's
     # axon tunnel), a dead relay makes the full probe hang for its
     # whole budget before the CPU fallback.  A TCP connect to the
-    # relay's stateless port answers in seconds either way.  Only a
-    # REFUSED/unreachable connect fails the preflight; anything that
-    # accepts (even slowly) proceeds to the real probe.
+    # relay's stateless port answers in seconds either way.  A
+    # refused/unreachable connect fails the preflight (forced cpu, no
+    # probe); a timeout is inconclusive and proceeds to the real
+    # probe, which has its own budget.
     if os.environ.get("AXON_LOOPBACK_RELAY"):
+        import errno
         import socket
 
         host = os.environ.get("PALLAS_AXON_POOL_IPS",
@@ -206,65 +208,66 @@ def select_backend():
         s.settimeout(5)
         try:
             s.connect((host, port))
-        except ConnectionRefusedError as e:
-            log(f"device relay {host}:{port} down ({e}); "
-                f"forcing cpu without probing")
-            info["outcome"] = f"relay_down: {e}"[:200]
-            os.environ["JAX_PLATFORMS"] = "cpu"
-            import jax
-            jax.config.update("jax_platforms", "cpu")
-            jax.default_backend()
-            return jax, info
         except OSError as e:
-            # timeout/other: inconclusive — let the real probe (with
-            # its own budget) decide
-            log(f"relay preflight inconclusive ({e}); probing anyway")
+            down = isinstance(e, ConnectionError) or e.errno in (
+                errno.EHOSTUNREACH, errno.ENETUNREACH)
+            if down:
+                log(f"device relay {host}:{port} down ({e}); "
+                    f"forcing cpu without probing")
+                info["outcome"] = f"relay_down: {e}"[:200]
+                forced_cpu = True
+            else:
+                log(f"relay preflight inconclusive ({e}); "
+                    f"probing anyway")
         finally:
             s.close()
     # Output goes to files, not pipes, and the probe gets its own
     # process group: a plugin-forked helper inheriting a pipe fd would
     # otherwise keep communicate() blocked past the child's death.
-    import signal
-    import tempfile
-    with tempfile.TemporaryFile("w+") as out, \
-            tempfile.TemporaryFile("w+") as err:
-        try:
-            p = subprocess.Popen([sys.executable, "-c", probe],
-                                 stdout=out, stderr=err,
-                                 start_new_session=True)
+    # A failed preflight skips the probe entirely and reuses the
+    # shared forced-cpu epilogue (and its init watchdog) below.
+    if not forced_cpu:
+        import signal
+        import tempfile
+        with tempfile.TemporaryFile("w+") as out, \
+                tempfile.TemporaryFile("w+") as err:
             try:
-                rc = p.wait(timeout=BACKEND_TIMEOUT)
-            except subprocess.TimeoutExpired:
-                log(f"backend probe hung > {BACKEND_TIMEOUT}s; "
-                    f"forcing cpu")
+                p = subprocess.Popen([sys.executable, "-c", probe],
+                                     stdout=out, stderr=err,
+                                     start_new_session=True)
                 try:
-                    os.killpg(p.pid, signal.SIGKILL)
-                except OSError:
-                    pass
-                p.wait()
-                rc = None
-                info["outcome"] = "hang"
+                    rc = p.wait(timeout=BACKEND_TIMEOUT)
+                except subprocess.TimeoutExpired:
+                    log(f"backend probe hung > {BACKEND_TIMEOUT}s; "
+                        f"forcing cpu")
+                    try:
+                        os.killpg(p.pid, signal.SIGKILL)
+                    except OSError:
+                        pass
+                    p.wait()
+                    rc = None
+                    info["outcome"] = "hang"
+                    forced_cpu = True
+                if rc == 0:
+                    out.seek(0)
+                    name = out.read().strip()
+                    log(f"backend probe ok: {name or '?'} "
+                        f"(timeout budget {BACKEND_TIMEOUT}s)")
+                    forced_cpu = not name
+                    info["outcome"] = "ok"
+                    info["platform"] = name or "?"
+                elif rc is not None:
+                    err.seek(0)
+                    tail = err.read().strip().splitlines()
+                    log(f"backend probe failed (rc={rc}): "
+                        f"{tail[-1] if tail else '?'}")
+                    forced_cpu = True
+                    info["outcome"] = f"rc={rc}"
+                    info["stderr_tail"] = " | ".join(tail[-3:])[:500]
+            except Exception as e:  # pragma: no cover - defensive
+                log(f"backend probe error: {e!r}; forcing cpu")
                 forced_cpu = True
-            if rc == 0:
-                out.seek(0)
-                name = out.read().strip()
-                log(f"backend probe ok: {name or '?'} "
-                    f"(timeout budget {BACKEND_TIMEOUT}s)")
-                forced_cpu = not name
-                info["outcome"] = "ok"
-                info["platform"] = name or "?"
-            elif rc is not None:
-                err.seek(0)
-                tail = err.read().strip().splitlines()
-                log(f"backend probe failed (rc={rc}): "
-                    f"{tail[-1] if tail else '?'}")
-                forced_cpu = True
-                info["outcome"] = f"rc={rc}"
-                info["stderr_tail"] = " | ".join(tail[-3:])[:500]
-        except Exception as e:  # pragma: no cover - defensive
-            log(f"backend probe error: {e!r}; forcing cpu")
-            forced_cpu = True
-            info["outcome"] = f"error: {e!r}"[:200]
+                info["outcome"] = f"error: {e!r}"[:200]
 
     if forced_cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
