@@ -1,0 +1,111 @@
+"""parseRequest validation matrix translated from the reference
+TestBadParseRequest / TestGoodParseRequest tables
+(/root/reference/etcdserver/etcdhttp/http_test.go).
+
+Unit-level: drives parse_request directly with the merged form dict
+(the handler's _form() merges query + body with body precedence; the
+precedence itself is covered end-to-end in test_http.py).
+"""
+
+import pytest
+
+from etcd_tpu.api import parse_request
+from etcd_tpu.utils.errors import (
+    ECODE_INDEX_NAN,
+    ECODE_INVALID_FIELD,
+    ECODE_INVALID_FORM,
+    ECODE_TTL_NAN,
+    EtcdError,
+)
+
+K = "/v2/keys/foo"
+
+
+# reference http_test.go TestBadParseRequest
+@pytest.mark.parametrize(
+    "method,path,form,wcode",
+    [
+        # bad key prefix
+        ("GET", "/badprefix/", {}, ECODE_INVALID_FORM),
+        # bad values for prevIndex, waitIndex, ttl
+        ("PUT", K, {"prevIndex": ["garbage"]}, ECODE_INDEX_NAN),
+        ("PUT", K, {"prevIndex": ["1.5"]}, ECODE_INDEX_NAN),
+        ("PUT", K, {"prevIndex": ["-1"]}, ECODE_INDEX_NAN),
+        ("GET", K, {"waitIndex": ["garbage"]}, ECODE_INDEX_NAN),
+        ("GET", K, {"waitIndex": ["??"]}, ECODE_INDEX_NAN),
+        ("PUT", K, {"ttl": ["-1"]}, ECODE_TTL_NAN),
+        ("PUT", K, {"ttl": ["wrong"]}, ECODE_TTL_NAN),
+        # bad values for recursive, sorted, wait, prevExist, dir, stream
+        ("GET", K, {"recursive": ["hahaha"]}, ECODE_INVALID_FIELD),
+        ("GET", K, {"recursive": ["1234"]}, ECODE_INVALID_FIELD),
+        ("GET", K, {"recursive": ["?"]}, ECODE_INVALID_FIELD),
+        ("GET", K, {"sorted": ["?"]}, ECODE_INVALID_FIELD),
+        ("GET", K, {"sorted": ["x"]}, ECODE_INVALID_FIELD),
+        ("GET", K, {"wait": ["?!"]}, ECODE_INVALID_FIELD),
+        ("GET", K, {"wait": ["yes"]}, ECODE_INVALID_FIELD),
+        ("PUT", K, {"prevExist": ["yes"]}, ECODE_INVALID_FIELD),
+        ("PUT", K, {"prevExist": ["#2"]}, ECODE_INVALID_FIELD),
+        ("PUT", K, {"dir": ["no"]}, ECODE_INVALID_FIELD),
+        ("PUT", K, {"dir": ["file"]}, ECODE_INVALID_FIELD),
+        ("GET", K, {"stream": ["zzz"]}, ECODE_INVALID_FIELD),
+        ("GET", K, {"stream": ["something"]}, ECODE_INVALID_FIELD),
+        # prevValue cannot be empty
+        ("PUT", K, {"prevValue": [""]}, ECODE_INVALID_FIELD),
+        # wait is only valid with GET requests
+        ("HEAD", K, {"wait": ["true"]}, ECODE_INVALID_FIELD),
+        ("PUT", K, {"wait": ["true"]}, ECODE_INVALID_FIELD),
+    ],
+)
+def test_bad_parse_request(method, path, form, wcode):
+    with pytest.raises(EtcdError) as ei:
+        parse_request(method, path, form, 1234)
+    assert ei.value.error_code == wcode
+
+
+# reference http_test.go TestGoodParseRequest — (form, want-attrs)
+@pytest.mark.parametrize(
+    "method,form,want",
+    [
+        # good prefix, all other values default
+        ("GET", {}, {"method": "GET", "path": "/foo"}),
+        ("PUT", {"value": ["some_value"]}, {"val": "some_value"}),
+        ("PUT", {"prevIndex": ["98765"]}, {"prev_index": 98765}),
+        ("PUT", {"recursive": ["true"]}, {"recursive": True}),
+        ("PUT", {"sorted": ["true"]}, {"sorted": True}),
+        ("GET", {"wait": ["true"]}, {"wait": True}),
+        # empty TTL specified
+        ("GET", {"ttl": [""]}, {"expiration": 0}),
+        ("GET", {"dir": ["true"]}, {"dir": True}),
+        ("GET", {"dir": ["false"]}, {"dir": False}),
+        # prevExist should be non-null if specified
+        ("PUT", {"prevExist": ["true"]}, {"prev_exist": True}),
+        ("PUT", {"prevExist": ["false"]}, {"prev_exist": False}),
+        # mix various fields
+        ("PUT", {"value": ["some value"], "prevExist": ["true"],
+                 "prevValue": ["previous value"]},
+         {"prev_exist": True, "prev_value": "previous value",
+          "val": "some value"}),
+        # Go strconv.ParseBool single-letter forms
+        ("GET", {"recursive": ["t"]}, {"recursive": True}),
+        ("GET", {"recursive": ["0"]}, {"recursive": False}),
+    ],
+)
+def test_good_parse_request(method, form, want):
+    r = parse_request(method, K, form, 1234)
+    assert r.id == 1234
+    assert r.path == "/foo"
+    for attr, val in want.items():
+        assert getattr(r, attr) == val, attr
+
+
+def test_prev_exist_unspecified_is_none():
+    r = parse_request("PUT", K, {"value": ["v"]}, 1)
+    assert r.prev_exist is None
+
+
+def test_ttl_sets_future_expiration():
+    import time
+
+    t0 = time.time()
+    r = parse_request("PUT", K, {"value": ["v"], "ttl": ["60"]}, 1)
+    assert r.expiration / 1e9 == pytest.approx(t0 + 60, abs=5)
