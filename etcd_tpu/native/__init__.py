@@ -205,13 +205,24 @@ def wal_gen(n_entries: int, payload_len: int, start_index: int = 1,
 
 
 def pad_rows(blob: np.ndarray, data_off: np.ndarray, data_len: np.ndarray,
-             width: int) -> np.ndarray:
-    """Right-align data spans into a zero-padded [n, width] buffer."""
+             width: int, out: np.ndarray | None = None) -> np.ndarray:
+    """Right-align data spans into a zero-padded [n, width] buffer.
+
+    ``out``, when given, is a preallocated C-contiguous uint8
+    [n, width] destination (e.g. a slice of one big batch array) —
+    large multi-group pipelines write each group straight into its
+    batch slot instead of paying a second full copy to concatenate.
+    """
     lib = _load()
     if lib is None:
         raise NativeError("native library unavailable")
     n = data_off.size
-    out = np.empty((n, width), np.uint8)
+    if out is None:
+        out = np.empty((n, width), np.uint8)
+    elif (out.shape != (n, width) or out.dtype != np.uint8
+          or not out.flags.c_contiguous or not out.flags.writeable):
+        raise ValueError(
+            "out must be writeable C-contiguous uint8 [n, width]")
     _check(lib.etcd_pad_rows(
         _u8(blob),
         np.ascontiguousarray(data_off, np.uint64).ctypes.data_as(

@@ -84,6 +84,27 @@ def test_pad_rows():
         assert rows[i, width - l:].tobytes() == blob_b[o:o + l]
 
 
+def test_pad_rows_into_preallocated_slice():
+    """out= writes a group straight into its slot of a batch array
+    (the multi-group pipeline's no-concat path) and zero-fills the
+    slot's padding even when the destination is dirty."""
+    blob = native.wal_gen(10, 24)
+    _, _, doff, dlen, _, _, _ = native.wal_scan(blob)
+    width = int(dlen.max()) + 4
+    batch = np.full((25, width), 0xAB, np.uint8)  # dirty destination
+    out = native.pad_rows(blob, doff, dlen, width, out=batch[5:15])
+    assert out.base is batch
+    expect = native.pad_rows(blob, doff, dlen, width)
+    assert np.array_equal(batch[5:15], expect)
+    assert np.all(batch[:5] == 0xAB) and np.all(batch[15:] == 0xAB)
+    with pytest.raises(ValueError, match="C-contiguous"):
+        native.pad_rows(blob, doff, dlen, width,
+                        out=np.empty((10, width + 1), np.uint8)[:, 1:])
+    with pytest.raises(ValueError, match="C-contiguous"):
+        native.pad_rows(blob, doff, dlen, width,
+                        out=np.empty((9, width), np.uint8))
+
+
 def test_scan_real_wal_file(tmp_path):
     """A WAL dir written by the Python tier replays natively."""
     w = WAL.create(str(tmp_path / "wal"), b"meta")
