@@ -1,0 +1,197 @@
+"""Recorder-seam server tests translated from the reference
+etcdserver/server_test.go (storeRecorder / storageRecorder /
+readyNode / nodeRecorder patterns, server_test.go:991-1160): the
+orchestrator is testable without disks, devices, or sockets.
+"""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from etcd_tpu.raft.node import Ready
+from etcd_tpu.server.cluster import ClusterStore
+from etcd_tpu.server.server import EtcdServer
+from etcd_tpu.store import Store
+from etcd_tpu.wire import Snapshot
+from etcd_tpu.wire.requests import Request
+
+
+class NodeRecorder:
+    """Scriptable fake raft node (reference readyNode/nodeRecorder)."""
+
+    def __init__(self):
+        self.actions = []
+        self.readyc = queue.Queue()
+        self.block_propose = False
+
+    def tick(self):
+        self.actions.append("tick")
+
+    def propose(self, data, timeout=None):
+        if self.block_propose:
+            self.actions.append("propose_blocked")
+            threading.Event().wait(timeout if timeout else 10)
+            raise TimeoutError("blocked")
+        self.actions.append("propose")
+
+    def step(self, m, timeout=None):
+        self.actions.append("step")
+
+    def apply_conf_change(self, cc):
+        self.actions.append("apply_conf_change")
+
+    def compact(self, index, nodes, d):
+        self.actions.append("compact")
+
+    def stop(self):
+        self.actions.append("stop")
+
+    def ready(self, timeout=None):
+        try:
+            return self.readyc.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+
+class StorageRecorder:
+    """Fake WAL+snapshotter (reference storageRecorder)."""
+
+    def __init__(self):
+        self.actions = []
+
+    def save(self, st, ents):
+        self.actions.append("save")
+
+    def save_snap(self, snap):
+        if snap.index or snap.data:
+            self.actions.append("save_snap")
+
+    def cut(self):
+        self.actions.append("cut")
+
+
+class StoreRecorder(Store):
+    """Real store wrapped with an action log (reference
+    storeRecorder records method names; subclassing keeps apply
+    semantics live while capturing the call sequence)."""
+
+    def __init__(self):
+        super().__init__()
+        self.actions = []
+
+    def recovery(self, data):
+        self.actions.append("recovery")
+
+    def get(self, *a, **kw):
+        self.actions.append("get")
+        return super().get(*a, **kw)
+
+    def watch(self, *a, **kw):
+        self.actions.append("watch")
+        return super().watch(*a, **kw)
+
+
+class ErrStore(Store):
+    """Every local read raises (reference errStoreRecorder)."""
+
+    class Boom(Exception):
+        pass
+
+    def __init__(self):
+        super().__init__()
+        self.actions = []
+
+    def get(self, *a, **kw):
+        self.actions.append("get")
+        raise self.Boom()
+
+    def watch(self, *a, **kw):
+        self.actions.append("watch")
+        raise self.Boom()
+
+
+def make_server(node=None, store=None, storage=None):
+    store = store if store is not None else StoreRecorder()
+    return EtcdServer(
+        store=store, node=node or NodeRecorder(), id=1,
+        attributes={"Name": "srv"}, storage=storage or StorageRecorder(),
+        send=lambda msgs: None, cluster_store=ClusterStore(store),
+        # short tick keeps the run loop's ready() wait small so
+        # stop() joins promptly in tests
+        tick_interval=0.05, sync_interval=10.0)
+
+
+# reference server_test.go TestDoBadLocalAction
+@pytest.mark.parametrize(
+    "req,waction",
+    [
+        (Request(method="GET", id=1, wait=True), "watch"),
+        (Request(method="GET", id=1), "get"),
+    ],
+)
+def test_do_bad_local_action(req, waction):
+    st = ErrStore()
+    srv = make_server(store=st)
+    with pytest.raises(ErrStore.Boom):
+        srv.do(req)
+    assert st.actions == [waction]
+
+
+# reference server_test.go TestRecvSnapshot
+def test_recv_snapshot():
+    n = NodeRecorder()
+    st = StoreRecorder()
+    p = StorageRecorder()
+    s = make_server(node=n, store=st, storage=p)
+    s._start()
+    n.readyc.put(Ready(snapshot=Snapshot(index=1, data=b"x")))
+    time.sleep(0.3)
+    s.stop()
+    assert st.actions == ["recovery"]
+    assert p.actions == ["save", "save_snap"]
+
+
+# reference server_test.go TestRecvSlowSnapshot
+def test_recv_slow_snapshot():
+    n = NodeRecorder()
+    st = StoreRecorder()
+    s = make_server(node=n, store=st)
+    s._start()
+    n.readyc.put(Ready(snapshot=Snapshot(index=1, data=b"x")))
+    time.sleep(0.3)
+    before = list(st.actions)
+    # an old/equal snapshot must not re-trigger recovery
+    n.readyc.put(Ready(snapshot=Snapshot(index=1, data=b"x")))
+    time.sleep(0.3)
+    s.stop()
+    assert st.actions == before
+
+
+# reference server_test.go TestSyncTimeout
+def test_sync_is_nonblocking_under_blocked_proposal():
+    n = NodeRecorder()
+    n.block_propose = True
+    s = make_server(node=n)
+    t0 = time.perf_counter()
+    s.sync(0.01)
+    assert time.perf_counter() - t0 < 0.05
+    time.sleep(0.1)  # let the bg proposal thread record the block
+    assert "propose_blocked" in n.actions
+
+
+# reference server_test.go TestPublishStopped
+def test_publish_stopped():
+    s = make_server()
+    s.done.set()
+    s.publish(retry_interval=3600.0)  # must return, not block
+
+
+# reference server_test.go TestPublishRetry
+def test_publish_retry():
+    n = NodeRecorder()
+    s = make_server(node=n)  # nothing ever commits -> do() times out
+    threading.Timer(0.25, s.done.set).start()
+    s.publish(retry_interval=0.02)
+    assert n.actions.count("propose") >= 2
