@@ -176,7 +176,10 @@ def test_sync_is_nonblocking_under_blocked_proposal():
     s = make_server(node=n)
     t0 = time.perf_counter()
     s.sync(0.01)
-    assert time.perf_counter() - t0 < 0.05
+    # the property is "returns immediately, not after the blocked
+    # proposal's multi-second wait"; a 1s ceiling keeps the check
+    # meaningful without flaking on a loaded box
+    assert time.perf_counter() - t0 < 1.0
     time.sleep(0.1)  # let the bg proposal thread record the block
     assert "propose_blocked" in n.actions
 
