@@ -101,6 +101,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Comma-separated slot-indexed peer base URLs "
                         "for --dist-slot mode (this host's own slot "
                         "included)")
+    p.add_argument("--dist-mesh-devices", type=int, default=0,
+                   help="Shard this host's group batch over its first "
+                        "N local devices (intra-host tier composed "
+                        "under the cross-host tier; --cohosted-groups "
+                        "must divide by the mesh's group axis; 0 = "
+                        "single device)")
     # v0.4.6 back-compat (main.go:87-98)
     p.add_argument("--addr", default=None,
                    help="DEPRECATED: Use --advertise-client-urls instead.")
@@ -201,11 +207,26 @@ def start_dist(args, explicit: set[str]) -> int:
     # member identity folds the slot in: hosts commonly share a
     # --name (the default!), and identical names would collapse to
     # one sha1 id whose registry entries overwrite each other
+    mesh = None
+    if args.dist_mesh_devices:
+        import jax
+
+        from .parallel.mesh import group_mesh
+
+        avail = len(jax.devices())
+        if args.dist_mesh_devices > avail:
+            # group_mesh would silently truncate to the available
+            # devices, hiding a host/flag misconfiguration
+            log.error("--dist-mesh-devices %d exceeds the %d "
+                      "available devices", args.dist_mesh_devices,
+                      avail)
+            return 1
+        mesh = group_mesh(args.dist_mesh_devices)
     s = DistServer(data_dir, slot=args.dist_slot, peer_urls=peers,
                    g=g, name=f"{args.name}-{args.dist_slot}",
                    snap_count=args.snapshot_count,
                    storage_backend=args.storage_backend,
-                   client_urls=list(acurls))
+                   client_urls=list(acurls), mesh=mesh)
     s.start()
     if args.dist_slot == 0 and s.fresh:
         # slot 0 bootstraps leadership for a BRAND-NEW cluster only

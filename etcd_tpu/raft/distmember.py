@@ -138,6 +138,25 @@ class DistMember:
         self.errors = {"overflow": np.zeros(g, bool),
                        "conflict": np.zeros(g, bool)}
 
+    # -- intra-host scale-out ---------------------------------------------
+
+    def shard(self, mesh) -> None:
+        """Shard every [G]-leading state array over the mesh's ``g``
+        axis (SURVEY §5.8's intra-slice tier composed under the
+        cross-host tier): groups are independent, so the batched
+        engine ops run SPMD across the mesh's devices with no
+        cross-device collectives, while the frame exchange above is
+        unchanged.  Callers re-invoke after wholesale state
+        replacement (restart seeding)."""
+        from ..parallel.mesh import shard_leading
+
+        per = mesh.shape["g"]
+        if self.g % per:
+            raise ValueError(
+                f"g={self.g} not divisible by mesh g-axis {per}")
+        self.state = type(self.state)(
+            *(shard_leading(mesh, x) for x in self.state))
+
     # -- views ------------------------------------------------------------
 
     def is_leader(self) -> np.ndarray:

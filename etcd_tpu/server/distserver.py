@@ -107,7 +107,8 @@ class DistServer:
                  election: int = 10,
                  storage_backend: str = "auto",
                  live: int | None = None,
-                 client_urls: list[str] | None = None):
+                 client_urls: list[str] | None = None,
+                 mesh=None):
         self.slot = slot
         self.g, self.m = g, len(peer_urls)
         # live member slots (< m leaves spare slots for runtime
@@ -120,6 +121,13 @@ class DistServer:
                 f"live={self.live} must be in 1..{self.m} "
                 f"(len(peer_urls))")
         self.peer_urls = list(peer_urls)
+        if mesh is not None and g % mesh.shape["g"]:
+            # validate BEFORE any disk mutation: failing after the
+            # fresh WAL is created would make the corrected retry
+            # look like a restart (fresh=False) and skip bootstrap
+            raise ValueError(
+                f"g={g} not divisible by mesh g-axis "
+                f"{mesh.shape['g']}")
         self.name = name or f"dist{slot}"
         self.snap_count = snap_count or DEFAULT_SNAP_COUNT
         self.tick_interval = tick_interval
@@ -191,6 +199,12 @@ class DistServer:
                 index=0, term=0,
                 data=GroupEntry(kind=K_FRONTIER,
                                 payload=zero + zero).marshal())])
+        # intra-host scale-out: this host's [G] batch sharded over a
+        # local device mesh (after restart seeding so the replayed
+        # arrays get placed too)
+        self.mesh = mesh
+        if mesh is not None:
+            self.mr.shard(mesh)
 
     # -- restart ----------------------------------------------------------
 

@@ -516,3 +516,45 @@ def test_partition_no_split_brain_then_heal_converges(cluster):
     wait_for(lambda: all(
         get(s, "/maj").event.node.value == "2" for s in servers),
         timeout=30.0, msg="partition-era majority write catch-up")
+
+
+# -- intra-host mesh sharding (two-tier composition) -----------------------
+
+
+def test_mesh_sharded_dist_cluster(tmp_path):
+    """SURVEY §5.8 composed end to end: each host's [G] group batch
+    sharded over the virtual device mesh (intra-slice tier) while
+    the cross-host frame exchange replicates between hosts (DCN
+    tier).  Groups are mesh-independent, so the engine runs SPMD
+    with no cross-device collectives."""
+    import jax
+
+    from etcd_tpu.parallel.mesh import group_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device (virtual) mesh")
+    mesh = group_mesh()
+    if G % mesh.shape["g"]:
+        pytest.skip(f"G={G} not divisible by mesh g-axis "
+                    f"{mesh.shape['g']} on this device count")
+    servers, _ = make_cluster(tmp_path, mesh=mesh)
+    try:
+        bootstrap_dist_leader(servers)
+        # state actually spans the mesh's devices, split on 'g'
+        # (replicated over 's', so the set covers the whole mesh)
+        sh = servers[0].mr.state.term.sharding
+        assert len(sh.device_set) == mesh.size
+        assert sh.spec[0] == "g"
+        ev = put(servers[0], "/m", "sharded")
+        assert ev.event.node.value == "sharded"
+        wait_for(lambda: all(
+            get(s, "/m").event.node.value == "sharded"
+            for s in servers[1:]), msg="replication with sharded state")
+        # engine transitions preserve multi-device placement
+        assert len(servers[0].mr.state.last.sharding.device_set) > 1
+    finally:
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
