@@ -91,6 +91,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "mode")
     p.add_argument("--cohosted-members", type=int, default=3,
                    help="Members per co-hosted group (default 3)")
+    p.add_argument("--cohosted-mesh-devices", type=int, default=0,
+                   help="Shard the co-hosted group batch over the "
+                        "first N local devices (--cohosted-groups "
+                        "must divide by the mesh's group axis; 0 = "
+                        "single device)")
     p.add_argument("--dist-slot", type=int, default=-1,
                    help="Run the DISTRIBUTED multi-group server as "
                         "member slot N of --dist-peers: each host "
@@ -207,26 +212,20 @@ def start_dist(args, explicit: set[str]) -> int:
     # member identity folds the slot in: hosts commonly share a
     # --name (the default!), and identical names would collapse to
     # one sha1 id whose registry entries overwrite each other
-    mesh = None
-    if args.dist_mesh_devices:
-        import jax
-
-        from .parallel.mesh import group_mesh
-
-        avail = len(jax.devices())
-        if args.dist_mesh_devices > avail:
-            # group_mesh would silently truncate to the available
-            # devices, hiding a host/flag misconfiguration
-            log.error("--dist-mesh-devices %d exceeds the %d "
-                      "available devices", args.dist_mesh_devices,
-                      avail)
-            return 1
-        mesh = group_mesh(args.dist_mesh_devices)
-    s = DistServer(data_dir, slot=args.dist_slot, peer_urls=peers,
-                   g=g, name=f"{args.name}-{args.dist_slot}",
-                   snap_count=args.snapshot_count,
-                   storage_backend=args.storage_backend,
-                   client_urls=list(acurls), mesh=mesh)
+    try:
+        mesh = _local_mesh(args.dist_mesh_devices)
+    except ValueError as e:
+        log.error("--dist-mesh-devices: %s", e)
+        return 1
+    try:
+        s = DistServer(data_dir, slot=args.dist_slot, peer_urls=peers,
+                       g=g, name=f"{args.name}-{args.dist_slot}",
+                       snap_count=args.snapshot_count,
+                       storage_backend=args.storage_backend,
+                       client_urls=list(acurls), mesh=mesh)
+    except ValueError as e:  # e.g. groups not divisible by mesh axis
+        log.error("dist config: %s", e)
+        return 1
     s.start()
     if args.dist_slot == 0 and s.fresh:
         # slot 0 bootstraps leadership for a BRAND-NEW cluster only
@@ -262,11 +261,20 @@ def start_multigroup(args, explicit: set[str]) -> int:
     client_tls = TLSInfo(args.cert_file, args.key_file, args.ca_file)
     acurls = urls_from_flags(args, "advertise_client_urls", "addr",
                              explicit, client_tls.empty())
-    s = MultiGroupServer(
-        data_dir, g=args.cohosted_groups, m=args.cohosted_members,
-        name=args.name, snap_count=args.snapshot_count,
-        storage_backend=args.storage_backend,
-        client_urls=list(acurls))
+    try:
+        mesh = _local_mesh(args.cohosted_mesh_devices)
+    except ValueError as e:
+        log.error("--cohosted-mesh-devices: %s", e)
+        return 1
+    try:
+        s = MultiGroupServer(
+            data_dir, g=args.cohosted_groups, m=args.cohosted_members,
+            name=args.name, snap_count=args.snapshot_count,
+            storage_backend=args.storage_backend,
+            client_urls=list(acurls), mesh=mesh)
+    except ValueError as e:  # e.g. groups not divisible by mesh axis
+        log.error("multigroup config: %s", e)
+        return 1
     s.start()
     cors = parse_cors(args.cors) if args.cors else None
     ch = make_client_handler(s, cors=cors)
@@ -369,6 +377,27 @@ def start_proxy(args, cluster: Cluster, explicit: set[str]) -> int:
 
     _block_forever()
     return 0
+
+
+def _local_mesh(n: int):
+    """Build a local device mesh over the first ``n`` devices, or
+    None when ``n`` is 0.  Fails fast when fewer devices exist —
+    group_mesh would silently truncate, hiding a host or XLA-flag
+    misconfiguration."""
+    if not n:
+        return None
+    if n < 0:
+        raise ValueError(f"mesh device count must be positive, "
+                         f"got {n}")
+    import jax
+
+    from .parallel.mesh import group_mesh
+
+    avail = len(jax.devices())
+    if n > avail:
+        raise ValueError(f"{n} mesh devices requested but only "
+                         f"{avail} available")
+    return group_mesh(n)
 
 
 def _split_hostport(u: str) -> tuple[str, int]:

@@ -329,6 +329,29 @@ class MultiRaft:
         # instead of re-uploading an [M, M, G] array per call
         self._no_drop = jnp.zeros((m, m, g), bool)
 
+    # -- intra-slice scale-out --------------------------------------------
+
+    def shard(self, mesh) -> None:
+        """Shard every member slot's [G]-leading state over the
+        mesh's ``g`` axis (BASELINE config 5 in serving shape):
+        groups are independent, so the fused rounds run SPMD across
+        the mesh with no cross-device collectives.  Callers re-invoke
+        after wholesale state replacement (restart seeding)."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.mesh import shard_leading
+
+        per = mesh.shape["g"]
+        if self.g % per:
+            raise ValueError(
+                f"g={self.g} not divisible by mesh g-axis {per}")
+        self.states = [
+            type(st)(*(shard_leading(mesh, x) for x in st))
+            for st in self.states]
+        self._no_drop = jax.device_put(
+            self._no_drop, NamedSharding(mesh, P(None, None, "g")))
+
     # -- elections (batched, fused, droppable) ---------------------------
 
     def campaign(self, slot: int, mask: np.ndarray | None = None,

@@ -98,8 +98,16 @@ class MultiGroupServer:
                  tick_interval: float = TICK_INTERVAL,
                  sync_interval: float = 0.5,
                  spare_member_slots: int = 1,
-                 client_urls: list[str] | None = None):
+                 client_urls: list[str] | None = None,
+                 mesh=None):
         from ..raft.multiraft import MultiRaft
+
+        if mesh is not None and g % mesh.shape["g"]:
+            # validate BEFORE any disk mutation (a post-WAL failure
+            # would make the corrected retry look like a restart)
+            raise ValueError(
+                f"g={g} not divisible by mesh g-axis "
+                f"{mesh.shape['g']}")
 
         # ``m`` live members now; ``spare_member_slots`` empty slots
         # are allocated so runtime AddMember has somewhere to land
@@ -163,6 +171,12 @@ class MultiGroupServer:
                 index=0, term=0,
                 data=GroupEntry(kind=1, payload=zero + zero)
                 .marshal())])
+        # intra-slice scale-out: the co-hosted batch sharded over a
+        # local device mesh (after restart seeding so the replayed
+        # arrays get placed too)
+        self.mesh = mesh
+        if mesh is not None:
+            self.mr.shard(mesh)
 
     # -- bootstrap / restart ---------------------------------------------
 
