@@ -213,19 +213,15 @@ def start_dist(args, explicit: set[str]) -> int:
     # --name (the default!), and identical names would collapse to
     # one sha1 id whose registry entries overwrite each other
     try:
-        mesh = _local_mesh(args.dist_mesh_devices)
+        mesh = _local_mesh(args.dist_mesh_devices, g)
     except ValueError as e:
         log.error("--dist-mesh-devices: %s", e)
         return 1
-    try:
-        s = DistServer(data_dir, slot=args.dist_slot, peer_urls=peers,
-                       g=g, name=f"{args.name}-{args.dist_slot}",
-                       snap_count=args.snapshot_count,
-                       storage_backend=args.storage_backend,
-                       client_urls=list(acurls), mesh=mesh)
-    except ValueError as e:  # e.g. groups not divisible by mesh axis
-        log.error("dist config: %s", e)
-        return 1
+    s = DistServer(data_dir, slot=args.dist_slot, peer_urls=peers,
+                   g=g, name=f"{args.name}-{args.dist_slot}",
+                   snap_count=args.snapshot_count,
+                   storage_backend=args.storage_backend,
+                   client_urls=list(acurls), mesh=mesh)
     s.start()
     if args.dist_slot == 0 and s.fresh:
         # slot 0 bootstraps leadership for a BRAND-NEW cluster only
@@ -262,19 +258,16 @@ def start_multigroup(args, explicit: set[str]) -> int:
     acurls = urls_from_flags(args, "advertise_client_urls", "addr",
                              explicit, client_tls.empty())
     try:
-        mesh = _local_mesh(args.cohosted_mesh_devices)
+        mesh = _local_mesh(args.cohosted_mesh_devices,
+                           args.cohosted_groups)
     except ValueError as e:
         log.error("--cohosted-mesh-devices: %s", e)
         return 1
-    try:
-        s = MultiGroupServer(
-            data_dir, g=args.cohosted_groups, m=args.cohosted_members,
-            name=args.name, snap_count=args.snapshot_count,
-            storage_backend=args.storage_backend,
-            client_urls=list(acurls), mesh=mesh)
-    except ValueError as e:  # e.g. groups not divisible by mesh axis
-        log.error("multigroup config: %s", e)
-        return 1
+    s = MultiGroupServer(
+        data_dir, g=args.cohosted_groups, m=args.cohosted_members,
+        name=args.name, snap_count=args.snapshot_count,
+        storage_backend=args.storage_backend,
+        client_urls=list(acurls), mesh=mesh)
     s.start()
     cors = parse_cors(args.cors) if args.cors else None
     ch = make_client_handler(s, cors=cors)
@@ -379,11 +372,13 @@ def start_proxy(args, cluster: Cluster, explicit: set[str]) -> int:
     return 0
 
 
-def _local_mesh(n: int):
+def _local_mesh(n: int, groups: int):
     """Build a local device mesh over the first ``n`` devices, or
-    None when ``n`` is 0.  Fails fast when fewer devices exist —
-    group_mesh would silently truncate, hiding a host or XLA-flag
-    misconfiguration."""
+    None when ``n`` is 0.  Fails fast (ValueError) on every flag
+    misconfiguration — negative/oversized counts (group_mesh would
+    silently truncate) and a group count that does not split over
+    the mesh — so the servers' own pre-disk guards never fire from
+    the CLI path."""
     if not n:
         return None
     if n < 0:
@@ -391,13 +386,15 @@ def _local_mesh(n: int):
                          f"got {n}")
     import jax
 
-    from .parallel.mesh import group_mesh
+    from .parallel.mesh import check_group_divisible, group_mesh
 
     avail = len(jax.devices())
     if n > avail:
         raise ValueError(f"{n} mesh devices requested but only "
                          f"{avail} available")
-    return group_mesh(n)
+    mesh = group_mesh(n)
+    check_group_divisible(mesh, groups)
+    return mesh
 
 
 def _split_hostport(u: str) -> tuple[str, int]:

@@ -8,6 +8,7 @@ doing the only cross-device communication (BASELINE config 5).
 """
 
 from .mesh import (
+    check_group_divisible,
     data_plane_step,
     group_mesh,
     make_replay_commit_step,
@@ -19,6 +20,7 @@ from .mesh import (
 
 __all__ = [
     "data_plane_step",
+    "check_group_divisible",
     "group_mesh",
     "make_replay_commit_step",
     "make_sharded_step",

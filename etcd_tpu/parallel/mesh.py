@@ -55,6 +55,15 @@ def group_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(arr, ("g", "s"))
 
 
+def check_group_divisible(mesh: Mesh, g: int) -> None:
+    """Raise ValueError unless ``g`` splits evenly over the mesh's
+    group axis — the one shared guard for every shard() entry point
+    and the servers' pre-disk validation."""
+    per = mesh.shape["g"]
+    if g % per:
+        raise ValueError(f"g={g} not divisible by mesh g-axis {per}")
+
+
 def shard_leading(mesh: Mesh, x, axis: str = "g"):
     """Place ``x`` with its leading axis sharded over ``axis``."""
     spec = P(axis, *([None] * (jnp.ndim(x) - 1)))

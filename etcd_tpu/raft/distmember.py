@@ -148,12 +148,9 @@ class DistMember:
         cross-device collectives, while the frame exchange above is
         unchanged.  Callers re-invoke after wholesale state
         replacement (restart seeding)."""
-        from ..parallel.mesh import shard_leading
+        from ..parallel.mesh import check_group_divisible, shard_leading
 
-        per = mesh.shape["g"]
-        if self.g % per:
-            raise ValueError(
-                f"g={self.g} not divisible by mesh g-axis {per}")
+        check_group_divisible(mesh, self.g)
         self.state = type(self.state)(
             *(shard_leading(mesh, x) for x in self.state))
 

@@ -340,12 +340,9 @@ class MultiRaft:
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
-        from ..parallel.mesh import shard_leading
+        from ..parallel.mesh import check_group_divisible, shard_leading
 
-        per = mesh.shape["g"]
-        if self.g % per:
-            raise ValueError(
-                f"g={self.g} not divisible by mesh g-axis {per}")
+        check_group_divisible(mesh, self.g)
         self.states = [
             type(st)(*(shard_leading(mesh, x) for x in st))
             for st in self.states]

@@ -121,13 +121,13 @@ class DistServer:
                 f"live={self.live} must be in 1..{self.m} "
                 f"(len(peer_urls))")
         self.peer_urls = list(peer_urls)
-        if mesh is not None and g % mesh.shape["g"]:
+        if mesh is not None:
             # validate BEFORE any disk mutation: failing after the
             # fresh WAL is created would make the corrected retry
             # look like a restart (fresh=False) and skip bootstrap
-            raise ValueError(
-                f"g={g} not divisible by mesh g-axis "
-                f"{mesh.shape['g']}")
+            from ..parallel.mesh import check_group_divisible
+
+            check_group_divisible(mesh, g)
         self.name = name or f"dist{slot}"
         self.snap_count = snap_count or DEFAULT_SNAP_COUNT
         self.tick_interval = tick_interval

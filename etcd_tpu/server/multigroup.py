@@ -102,12 +102,12 @@ class MultiGroupServer:
                  mesh=None):
         from ..raft.multiraft import MultiRaft
 
-        if mesh is not None and g % mesh.shape["g"]:
+        if mesh is not None:
             # validate BEFORE any disk mutation (a post-WAL failure
             # would make the corrected retry look like a restart)
-            raise ValueError(
-                f"g={g} not divisible by mesh g-axis "
-                f"{mesh.shape['g']}")
+            from ..parallel.mesh import check_group_divisible
+
+            check_group_divisible(mesh, g)
 
         # ``m`` live members now; ``spare_member_slots`` empty slots
         # are allocated so runtime AddMember has somewhere to land
