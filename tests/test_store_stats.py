@@ -1,0 +1,91 @@
+"""Per-op stats counters translated from the reference
+store/stats_test.go matrix: every success/fail counter increments on
+exactly its own operation."""
+
+import pytest
+
+from etcd_tpu.store import Store
+from etcd_tpu.utils.errors import EtcdError
+
+
+def _mk():
+    s = Store()
+    s.create("/foo", False, "bar", False, None)
+    return s
+
+
+# reference stats_test.go TestStoreStats*{Success,Fail}
+def test_get_success():
+    s = _mk()
+    s.get("/foo", False, False)
+    assert s.stats.get_success == 1
+
+
+def test_get_fail():
+    s = _mk()
+    with pytest.raises(EtcdError):
+        s.get("/no_such_key", False, False)
+    assert s.stats.get_fail == 1
+
+
+def test_create_success():
+    s = Store()
+    s.create("/foo", False, "bar", False, None)
+    assert s.stats.create_success == 1
+
+
+def test_create_fail():
+    s = _mk()
+    with pytest.raises(EtcdError):
+        s.create("/foo", False, "bar", False, None)
+    assert s.stats.create_fail == 1
+
+
+def test_update_success():
+    s = _mk()
+    s.update("/foo", "baz", None)
+    assert s.stats.update_success == 1
+
+
+def test_update_fail():
+    s = Store()
+    with pytest.raises(EtcdError):
+        s.update("/no_such_key", "baz", None)
+    assert s.stats.update_fail == 1
+
+
+def test_cas_success():
+    s = _mk()
+    s.compare_and_swap("/foo", "bar", 0, "baz", None)
+    assert s.stats.compare_and_swap_success == 1
+
+
+def test_cas_fail():
+    s = _mk()
+    with pytest.raises(EtcdError):
+        s.compare_and_swap("/foo", "wrong_value", 0, "baz", None)
+    assert s.stats.compare_and_swap_fail == 1
+
+
+def test_delete_success():
+    s = _mk()
+    s.delete("/foo", False, False)
+    assert s.stats.delete_success == 1
+
+
+def test_delete_fail():
+    s = Store()
+    with pytest.raises(EtcdError):
+        s.delete("/no_such_key", False, False)
+    assert s.stats.delete_fail == 1
+
+
+def test_expire_count():
+    # reference TestStoreStatsExpireCount drives the TTL clock; here
+    # the deterministic cutoff form: expired keys count on expiry
+    import time
+
+    s = Store()
+    s.create("/tmp", False, "v", False, time.time() + 0.01)
+    s.delete_expired_keys(time.time() + 1.0)
+    assert s.stats.expire_count == 1

@@ -1,0 +1,97 @@
+"""TTL min-heap unit tests translated from the reference
+store/heap_test.go (TestHeapPushPop, TestHeapUpdate) plus direct
+remove and randomized-order coverage."""
+
+import random
+
+from etcd_tpu.store.ttl_heap import TTLKeyHeap
+
+
+class _Node:
+    """Minimal stand-in: the heap needs expire_time and hashability
+    (nodes key the position map)."""
+
+    def __init__(self, path, expire):
+        self.path = path
+        self.expire_time = expire
+
+
+def _node(path, expire):
+    return _Node(path, expire)
+
+
+# reference heap_test.go:9 TestHeapPushPop
+def test_heap_push_pop():
+    h = TTLKeyHeap()
+    # add from later expire time to earlier expire time
+    for i in range(10):
+        m = 10 - i
+        h.push(_node(str(m), 100.0 + m))
+    prev = 0.0
+    for _ in range(10):
+        node = h.pop()
+        assert node.expire_time >= prev, "heap sort wrong"
+        prev = node.expire_time
+    assert h.pop() is None
+
+
+# reference heap_test.go:33 TestHeapUpdate
+def test_heap_update():
+    h = TTLKeyHeap()
+    kvs = []
+    for i in range(10):
+        m = 10 - i
+        n = _node(str(m), 100.0 + m)
+        kvs.append(n)
+        h.push(n)
+    # push paths "7" and "5" beyond everything else
+    kvs[3].expire_time = 111.0
+    kvs[5].expire_time = 112.0
+    h.update(kvs[3])
+    h.update(kvs[5])
+    prev = 0.0
+    for i in range(10):
+        node = h.pop()
+        assert node.expire_time >= prev, "heap sort wrong"
+        prev = node.expire_time
+        if i == 8:
+            assert node.path == "7"
+        if i == 9:
+            assert node.path == "5"
+
+
+def test_heap_remove_and_top():
+    h = TTLKeyHeap()
+    nodes = [_node(str(i), float(i)) for i in range(6)]
+    for n in nodes:
+        h.push(n)
+    assert h.top() is nodes[0]
+    h.remove(nodes[0])       # remove the min
+    h.remove(nodes[3])       # remove from the middle
+    h.remove(nodes[3])       # double-remove is a no-op
+    assert len(h) == 4
+    got = [h.pop().path for _ in range(4)]
+    assert got == ["1", "2", "4", "5"]
+
+
+def test_heap_randomized_order_property():
+    rng = random.Random(11)
+    h = TTLKeyHeap()
+    nodes = [_node(f"/k{i}", rng.random()) for i in range(200)]
+    for n in nodes:
+        h.push(n)
+    # random updates and removes keep the heap invariant
+    for n in rng.sample(nodes, 50):
+        n.expire_time = rng.random()
+        h.update(n)
+    removed = set()
+    for n in rng.sample(nodes, 30):
+        h.remove(n)
+        removed.add(n.path)
+    out = []
+    while (n := h.pop()) is not None:
+        out.append(n)
+    assert len(out) == 200 - 30
+    assert all(o.path not in removed for o in out)
+    times = [o.expire_time for o in out]
+    assert times == sorted(times)
