@@ -96,15 +96,16 @@ def _parse_record_span(raw: bytes, base: int, rlen: int):
 
     Returns ``(type, crc, data_off_abs, data_len)``.
     """
-    from ..wire.proto import _expect_wt, _skip_field, uvarint
+    from ..wire.proto import _expect_wt, _skip_field, _tag, uvarint
 
     end = base + rlen
     rtype = crc = 0
     doff, dlen = base, 0
     pos = base
     while pos < end:
-        tag, pos = uvarint(raw, pos)
-        fnum, wt = tag >> 3, tag & 7
+        # _tag rejects field number 0 exactly like Record.unmarshal —
+        # both replay lanes must agree on record validity
+        fnum, wt, pos = _tag(raw, pos)
         if fnum == 1:
             _expect_wt(fnum, wt, 0)  # corrupt framing aborts, never
             rtype, pos = uvarint(raw, pos)  # masks (proto.py parity)

@@ -46,6 +46,18 @@ def put_uvarint(buf: bytearray, v: int) -> None:
     buf.append(v)
 
 
+def _tag(data: bytes, pos: int) -> tuple[int, int, int]:
+    """Read a field tag, rejecting field number 0 — the generated
+    unmarshalers error with "illegal tag 0" (gogoproto) rather than
+    skipping; parity matters because a zero tag usually means a
+    corrupt or misframed buffer."""
+    tag, pos = uvarint(data, pos)
+    fnum, wt = tag >> 3, tag & 7
+    if fnum == 0:
+        raise ProtoError(f"illegal tag 0 (wire type {wt})")
+    return fnum, wt, pos
+
+
 def uvarint(data: bytes, pos: int) -> tuple[int, int]:
     """Decode a varint at ``pos``; returns (value, new_pos)."""
     result = 0
@@ -165,8 +177,7 @@ class Entry:
         e = cls()
         pos = 0
         while pos < len(data):
-            tag, pos = uvarint(data, pos)
-            fnum, wt = tag >> 3, tag & 7
+            fnum, wt, pos = _tag(data, pos)
             if fnum == 1:
                 _expect_wt(fnum, wt, 0)
                 e.type, pos = uvarint(data, pos)
@@ -208,8 +219,7 @@ class Snapshot:
         s = cls()
         pos = 0
         while pos < len(data):
-            tag, pos = uvarint(data, pos)
-            fnum, wt = tag >> 3, tag & 7
+            fnum, wt, pos = _tag(data, pos)
             if fnum == 1:
                 _expect_wt(fnum, wt, 2)
                 s.data, pos = _bytes_field(data, pos)
@@ -270,8 +280,7 @@ class Message:
         m = cls()
         pos = 0
         while pos < len(data):
-            tag, pos = uvarint(data, pos)
-            fnum, wt = tag >> 3, tag & 7
+            fnum, wt, pos = _tag(data, pos)
             if fnum == 1:
                 _expect_wt(fnum, wt, 0)
                 m.type, pos = uvarint(data, pos)
@@ -328,8 +337,7 @@ class HardState:
         s = cls()
         pos = 0
         while pos < len(data):
-            tag, pos = uvarint(data, pos)
-            fnum, wt = tag >> 3, tag & 7
+            fnum, wt, pos = _tag(data, pos)
             if fnum == 1:
                 _expect_wt(fnum, wt, 0)
                 s.term, pos = uvarint(data, pos)
@@ -377,8 +385,7 @@ class ConfChange:
         c = cls()
         pos = 0
         while pos < len(data):
-            tag, pos = uvarint(data, pos)
-            fnum, wt = tag >> 3, tag & 7
+            fnum, wt, pos = _tag(data, pos)
             if fnum == 1:
                 _expect_wt(fnum, wt, 0)
                 c.id, pos = uvarint(data, pos)
@@ -421,8 +428,7 @@ class Record:
         r = cls()
         pos = 0
         while pos < len(data):
-            tag, pos = uvarint(data, pos)
-            fnum, wt = tag >> 3, tag & 7
+            fnum, wt, pos = _tag(data, pos)
             if fnum == 1:
                 _expect_wt(fnum, wt, 0)
                 r.type, pos = uvarint(data, pos)
@@ -477,8 +483,7 @@ class GroupEntry:
         ge = cls()
         pos = 0
         while pos < len(data):
-            tag, pos = uvarint(data, pos)
-            fnum, wt = tag >> 3, tag & 7
+            fnum, wt, pos = _tag(data, pos)
             if fnum == 1:
                 _expect_wt(fnum, wt, 0)
                 ge.kind, pos = uvarint(data, pos)
@@ -521,8 +526,7 @@ class SnapPb:
         s = cls()
         pos = 0
         while pos < len(data):
-            tag, pos = uvarint(data, pos)
-            fnum, wt = tag >> 3, tag & 7
+            fnum, wt, pos = _tag(data, pos)
             if fnum == 1:
                 _expect_wt(fnum, wt, 0)
                 s.crc, pos = uvarint(data, pos)

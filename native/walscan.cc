@@ -155,6 +155,7 @@ int64_t parse_record(const uint8_t* buf, uint64_t pos, uint64_t rend,
     pos = uvarint(buf, pos, rend, &tag);
     if (!pos) return kErrProto;
     uint64_t fnum = tag >> 3, wt = tag & 7;
+    if (fnum == 0) return kErrProto;  // illegal tag 0 (proto.py _tag parity)
     uint64_t v;
     switch (fnum) {
       case 1:
@@ -210,6 +211,7 @@ int64_t parse_entry(const uint8_t* buf, uint64_t pos, uint64_t rend,
     pos = uvarint(buf, pos, rend, &tag);
     if (!pos) return kErrProto;
     uint64_t fnum = tag >> 3, wt = tag & 7;
+    if (fnum == 0) return kErrProto;  // illegal tag 0 (proto.py _tag parity)
     uint64_t v;
     if (wt == 0) {
       pos = uvarint(buf, pos, rend, &v);
@@ -274,6 +276,7 @@ int64_t etcd_ge_scan(const uint8_t* buf, uint64_t n, const uint64_t* off,
       epos = uvarint(buf, epos, eend, &tag);
       if (!epos) return kErrProto;
       uint64_t fnum = tag >> 3, wt = tag & 7, v;
+      if (fnum == 0) return kErrProto;  // illegal tag 0 (proto.py _tag parity)
       if (fnum == 4 && wt == 2) {
         epos = uvarint(buf, epos, eend, &v);
         if (!epos || v > eend - epos) return kErrProto;
@@ -304,6 +307,7 @@ int64_t etcd_ge_scan(const uint8_t* buf, uint64_t n, const uint64_t* off,
       pos = uvarint(buf, pos, rend, &tag);
       if (!pos) return kErrProto;
       uint64_t fnum = tag >> 3, wt = tag & 7;
+      if (fnum == 0) return kErrProto;  // illegal tag 0 (proto.py _tag parity)
       uint64_t v;
       if (fnum >= 1 && fnum <= 4) {
         if (wt != 0) return kErrProto;

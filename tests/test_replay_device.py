@@ -323,3 +323,31 @@ def test_mixed_width_records(tmp_path):
     _, _, block = read_all_device(str(d), 0)
     assert len(block) == 60
     assert int(block.data_len[50]) > 50000
+
+
+def test_zero_tag_rejected_identically_on_all_lanes():
+    """A record containing an illegal zero tag must be rejected by
+    EVERY replay lane — host Record.unmarshal, the python span
+    parser, and the native scanner — so the two replay paths can
+    never reconstruct different state from the same corrupt bytes
+    (proto.py _tag / walscan.cc parity)."""
+    import struct
+
+    from etcd_tpu.wire.proto import ProtoError, Record
+
+    rec = Record(type=1, crc=7, data=b"hello").marshal() + b"\x00"
+    with pytest.raises(ProtoError, match="illegal tag 0"):
+        Record.unmarshal(rec)
+
+    blob = struct.pack("<q", len(rec)) + rec
+    arr = np.frombuffer(blob, dtype=np.uint8).copy()
+
+    from etcd_tpu.wal.errors import WALError
+    from etcd_tpu.wal.replay_device import _scan_python
+
+    with pytest.raises((ProtoError, WALError)):
+        _scan_python(arr)
+
+    if native.available():
+        with pytest.raises(native.NativeError):
+            native.wal_scan(arr)

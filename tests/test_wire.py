@@ -161,3 +161,16 @@ def test_group_entry_roundtrip():
     # None payload omits the field entirely (gogoproto nil semantics)
     empty = GroupEntry.unmarshal(GroupEntry(kind=1).marshal())
     assert empty.payload is None
+
+
+def test_illegal_tag_zero_rejected():
+    """Field number 0 is an illegal tag — the generated unmarshalers
+    reject it ("illegal tag 0") instead of skipping; a zero tag means
+    a corrupt or misframed buffer."""
+    from etcd_tpu.wire.proto import ProtoError
+
+    good = Entry(term=3, index=4, data=b"x").marshal()
+    with pytest.raises(ProtoError, match="illegal tag 0"):
+        Entry.unmarshal(b"\x00" + good)
+    with pytest.raises(ProtoError, match="illegal tag 0"):
+        Entry.unmarshal(good + b"\x00")
