@@ -255,3 +255,35 @@ def test_proxy_endpoints_live_server(tmp_path):
     finally:
         httpd.shutdown()
         srv.stop()
+
+
+# reference discovery_test.go TestNodesToCluster
+def test_nodes_to_cluster():
+    nodes = [
+        {"key": "/1000/1", "value": "1=1.1.1.1", "createdIndex": 1},
+        {"key": "/1000/2", "value": "2=2.2.2.2", "createdIndex": 2},
+        {"key": "/1000/3", "value": "3=3.3.3.3", "createdIndex": 3},
+    ]
+    assert disc_mod.nodes_to_cluster(nodes) == \
+        "1=1.1.1.1,2=2.2.2.2,3=3.3.3.3"
+
+
+# reference discovery_test.go TestSortableNodes
+def test_discover_orders_peers_by_created_index():
+    """The discovery registry may return nodes in ANY order; the
+    bootstrapped cluster string (and so the first-N-of-size cut)
+    must be createdIndex-ordered — through the production discover()
+    path, not a local sort."""
+    import random
+
+    rng = random.Random(5)
+    idxs = [5, 1, 3, 4] + rng.sample(range(10, 1 << 20), 60)
+    nodes = [{"key": f"/c/{i:x}" if i != 1 else "/c/1",
+              "value": f"n{i}=http://h{i}:7001",
+              "createdIndex": i} for i in idxs]
+    rng.shuffle(nodes)  # arrival order is NOT index order
+    d = Discoverer("http://disc.example.com/c", 1,
+                   "n1=http://h1:7001",
+                   client=FakeClient(len(nodes), nodes))
+    got = d.discover().split(",")
+    assert got == [f"n{i}=http://h{i}:7001" for i in sorted(idxs)]
