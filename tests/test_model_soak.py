@@ -1,0 +1,155 @@
+"""Model-based soak: random op sequences against a real server vs a
+flat-dict reference model, compared after apply and after a full
+restart replay.
+
+The SURVEY §4 fake-network/table tests pin individual behaviors;
+this harness pins the COMPOSITION: any interleaving of create / set /
+update / delete / CAS / CAD over a shared keyspace must leave the
+replicated store exactly where the sequential model says, and a WAL
+replay must reconstruct the same state byte for byte.
+"""
+
+import random
+import time
+
+import pytest
+
+from etcd_tpu.server.cluster import Cluster
+from etcd_tpu.server.server import ServerConfig, gen_id, new_server
+from etcd_tpu.utils.errors import EtcdError
+from etcd_tpu.wire.requests import Request
+
+from test_server import wait_for_leader
+
+KEYS = [f"/soak/k{i}" for i in range(8)]
+
+
+def _apply_model(model, op, key, val, prev_val):
+    """Sequential-spec semantics of one op; returns whether the op
+    should succeed on the real store too."""
+    if op == "create":
+        if key in model:
+            return False
+        model[key] = val
+        return True
+    if op == "set":
+        model[key] = val
+        return True
+    if op == "update":
+        if key not in model:
+            return False
+        model[key] = val
+        return True
+    if op == "delete":
+        return model.pop(key, None) is not None
+    if op == "cas":
+        if key not in model or model[key] != prev_val:
+            return False
+        model[key] = val
+        return True
+    if op == "cad":
+        if key not in model or model[key] != prev_val:
+            return False
+        del model[key]
+        return True
+    raise AssertionError(op)
+
+
+def _do_real(s, op, key, val, prev_val):
+    """The same op through the server's consensus path; returns
+    success."""
+    r = Request(id=gen_id(), method="PUT", path=key, val=val)
+    if op == "create":
+        r.prev_exist = False
+    elif op == "update":
+        r.prev_exist = True
+    elif op == "delete":
+        r = Request(id=gen_id(), method="DELETE", path=key)
+    elif op == "cas":
+        r.prev_value = prev_val
+    elif op == "cad":
+        r = Request(id=gen_id(), method="DELETE", path=key,
+                    prev_value=prev_val)
+    try:
+        s.do(r, timeout=10)
+        return True
+    except EtcdError:
+        return False
+
+
+def _store_view(s):
+    """Flat {path: value} of the live keyspace under /soak."""
+    try:
+        ev = s.store.get("/soak", True, True)
+    except EtcdError:
+        return {}
+    out = {}
+
+    def walk(n):
+        if n.dir:
+            for c in n.nodes or []:
+                walk(c)
+        else:
+            out[n.key] = n.value
+
+    walk(ev.node)
+    return out
+
+
+def _mk(tmp_path):
+    cluster = Cluster()
+    cluster.set_from_string("soak=http://127.0.0.1:7031")
+    cfg = ServerConfig(name="soak", data_dir=str(tmp_path),
+                       cluster=cluster,
+                       client_urls=["http://127.0.0.1:4031"])
+    s = new_server(cfg)
+    s.tick_interval = 0.01
+    s._start()
+    wait_for_leader({1: s})
+    return s
+
+
+@pytest.mark.parametrize("seed", [3, 17])
+def test_soak_random_ops_match_model_and_survive_restart(
+        tmp_path, seed):
+    rng = random.Random(seed)
+    model = {}
+    s = _mk(tmp_path)
+    agree = disagree = 0
+    try:
+        for step in range(300):
+            op = rng.choice(["create", "set", "update", "delete",
+                             "cas", "cad"])
+            key = rng.choice(KEYS)
+            val = f"v{step}"
+            # half the CAS/CAD attempts guess right on purpose (an
+            # absent key has no right guess: those must fail)
+            prev_val = model.get(key, "wrong") \
+                if rng.random() < 0.5 else "wrong"
+            # _apply_model mutates only on success, so it can apply
+            # directly to the live model
+            want = _apply_model(model, op, key, val, prev_val)
+            got = _do_real(s, op, key, val, prev_val)
+            assert got == want, (step, op, key, prev_val)
+            if want:
+                agree += 1
+            else:
+                disagree += 1
+            if step % 60 == 59:  # periodic full-state compare
+                assert _store_view(s) == model, f"divergence @ {step}"
+        assert _store_view(s) == model
+        assert agree > 50 and disagree > 20  # both paths exercised
+    finally:
+        s.stop()
+
+    # restart: WAL replay must reconstruct the identical keyspace
+    s2 = _mk(tmp_path)
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if _store_view(s2) == model:
+                break
+            time.sleep(0.05)
+        assert _store_view(s2) == model, "replay diverged from model"
+    finally:
+        s2.stop()
