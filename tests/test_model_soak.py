@@ -77,10 +77,10 @@ def _do_real(s, op, key, val, prev_val):
         return False
 
 
-def _store_view(s):
-    """Flat {path: value} of the live keyspace under /soak."""
+def _view(s, prefix):
+    """Flat {path: value} of the live keyspace under ``prefix``."""
     try:
-        ev = s.store.get("/soak", True, True)
+        ev = s.store.get(prefix, True, True)
     except EtcdError:
         return {}
     out = {}
@@ -94,6 +94,10 @@ def _store_view(s):
 
     walk(ev.node)
     return out
+
+
+def _store_view(s):
+    return _view(s, "/soak")
 
 
 def _mk(tmp_path):
@@ -151,5 +155,60 @@ def test_soak_random_ops_match_model_and_survive_restart(
                 break
             time.sleep(0.05)
         assert _store_view(s2) == model, "replay diverged from model"
+    finally:
+        s2.stop()
+
+
+# -- the same harness against the flagship batched server ------------------
+
+
+MG_KEYS = [f"/ns{g}/k{i}" for g in range(5) for i in range(3)]
+
+
+def _mg_view(s):
+    return {k: v for g in range(5)
+            for k, v in _view(s, f"/ns{g}").items()}
+
+
+def test_soak_multigroup_matches_model_and_survives_restart(tmp_path):
+    """The batched engine behind the same sequential spec: ops spread
+    across G groups (namespace routing), every result and the final
+    keyspace must match the model, and the multiplexed-WAL restart
+    must reconstruct it."""
+    from etcd_tpu.server.multigroup import MultiGroupServer
+
+    rng = random.Random(23)
+    model = {}
+
+    def mk():
+        s = MultiGroupServer(str(tmp_path / "mg"), g=8, m=3, cap=64,
+                             tick_interval=0.02)
+        s.start()
+        return s
+
+    s = mk()
+    try:
+        for step in range(200):
+            op = rng.choice(["create", "set", "update", "delete",
+                             "cas", "cad"])
+            key = rng.choice(MG_KEYS)
+            val = f"v{step}"
+            prev_val = model.get(key, "wrong") \
+                if rng.random() < 0.5 else "wrong"
+            want = _apply_model(model, op, key, val, prev_val)
+            got = _do_real(s, op, key, val, prev_val)
+            assert got == want, (step, op, key, prev_val)
+        assert _mg_view(s) == model
+    finally:
+        s.stop()
+
+    s2 = mk()
+    try:
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if _mg_view(s2) == model:
+                break
+            time.sleep(0.05)
+        assert _mg_view(s2) == model, "batched replay diverged"
     finally:
         s2.stop()
