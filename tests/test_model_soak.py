@@ -100,6 +100,35 @@ def _store_view(s):
     return _view(s, "/soak")
 
 
+
+def _soak_steps(s, rng, keys, model, n, check=None):
+    """Shared soak loop: n random ops against server ``s`` and the
+    model; asserts per-op agreement, runs ``check()`` every 60 steps,
+    returns (agree, disagree)."""
+    agree = disagree = 0
+    for step in range(n):
+        op = rng.choice(["create", "set", "update", "delete",
+                         "cas", "cad"])
+        key = rng.choice(keys)
+        val = f"v{step}"
+        # half the CAS/CAD attempts guess right on purpose (an
+        # absent key has no right guess: those must fail)
+        prev_val = model.get(key, "wrong") \
+            if rng.random() < 0.5 else "wrong"
+        # _apply_model mutates only on success, so it can apply
+        # directly to the live model
+        want = _apply_model(model, op, key, val, prev_val)
+        got = _do_real(s, op, key, val, prev_val)
+        assert got == want, (step, op, key, prev_val)
+        if want:
+            agree += 1
+        else:
+            disagree += 1
+        if check is not None and step % 60 == 59:
+            check(step)
+    return agree, disagree
+
+
 def _mk(tmp_path):
     cluster = Cluster()
     cluster.set_from_string("soak=http://127.0.0.1:7031")
@@ -119,28 +148,12 @@ def test_soak_random_ops_match_model_and_survive_restart(
     rng = random.Random(seed)
     model = {}
     s = _mk(tmp_path)
-    agree = disagree = 0
     try:
-        for step in range(300):
-            op = rng.choice(["create", "set", "update", "delete",
-                             "cas", "cad"])
-            key = rng.choice(KEYS)
-            val = f"v{step}"
-            # half the CAS/CAD attempts guess right on purpose (an
-            # absent key has no right guess: those must fail)
-            prev_val = model.get(key, "wrong") \
-                if rng.random() < 0.5 else "wrong"
-            # _apply_model mutates only on success, so it can apply
-            # directly to the live model
-            want = _apply_model(model, op, key, val, prev_val)
-            got = _do_real(s, op, key, val, prev_val)
-            assert got == want, (step, op, key, prev_val)
-            if want:
-                agree += 1
-            else:
-                disagree += 1
-            if step % 60 == 59:  # periodic full-state compare
-                assert _store_view(s) == model, f"divergence @ {step}"
+        def check(step):  # periodic full-state compare
+            assert _store_view(s) == model, f"divergence @ {step}"
+
+        agree, disagree = _soak_steps(s, rng, KEYS, model, 300,
+                                      check=check)
         assert _store_view(s) == model
         assert agree > 50 and disagree > 20  # both paths exercised
     finally:
@@ -188,16 +201,7 @@ def test_soak_multigroup_matches_model_and_survives_restart(tmp_path):
 
     s = mk()
     try:
-        for step in range(200):
-            op = rng.choice(["create", "set", "update", "delete",
-                             "cas", "cad"])
-            key = rng.choice(MG_KEYS)
-            val = f"v{step}"
-            prev_val = model.get(key, "wrong") \
-                if rng.random() < 0.5 else "wrong"
-            want = _apply_model(model, op, key, val, prev_val)
-            got = _do_real(s, op, key, val, prev_val)
-            assert got == want, (step, op, key, prev_val)
+        _soak_steps(s, rng, MG_KEYS, model, 200)
         assert _mg_view(s) == model
     finally:
         s.stop()
@@ -212,3 +216,32 @@ def test_soak_multigroup_matches_model_and_survives_restart(tmp_path):
         assert _mg_view(s2) == model, "batched replay diverged"
     finally:
         s2.stop()
+
+
+def test_soak_distserver_matches_model(tmp_path):
+    """The distributed tier behind the same sequential spec: ops land
+    on the leader host, every result matches the model, and follower
+    replicas converge to the identical keyspace."""
+    from conftest import bootstrap_dist_leader, make_dist_cluster
+
+    rng = random.Random(31)
+    model = {}
+    servers, _ = make_dist_cluster(tmp_path, m=3, g=8)
+    try:
+        bootstrap_dist_leader(servers)
+        _soak_steps(servers[0], rng, MG_KEYS, model, 80)
+        assert _mg_view(servers[0]) == model
+        # follower replicas converge to the same keyspace
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if all(_mg_view(s) == model for s in servers[1:]):
+                break
+            time.sleep(0.1)
+        for i, s in enumerate(servers[1:], 1):
+            assert _mg_view(s) == model, f"replica {i} diverged"
+    finally:
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
