@@ -493,3 +493,17 @@ def test_json_stats():
     assert d["getsSuccess"] == 1
     assert d["getsFail"] == 1
     assert s.total_transactions() == 1
+
+
+def test_watch_recursive_inside_hidden_subtree_fires():
+    """Reference TestStoreWatchRecursiveCreateDeeperThanHiddenKey:
+    hidden filtering applies to ANCESTOR watchers, not to a watcher
+    scoped under the hidden path itself — a recursive watch at
+    /_foo/bar still fires for /_foo/bar/baz."""
+    s = Store()
+    w = s.watch("/_foo/bar", True, False, 0)
+    s.create("/_foo/bar/baz", False, "baz", False, None)
+    ev = w.next_event(timeout=1)
+    assert ev is not None
+    assert ev.action == "create"
+    assert ev.node.key == "/_foo/bar/baz"
