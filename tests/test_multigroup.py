@@ -451,3 +451,34 @@ def test_mesh_sharded_multigroup_serves_and_restarts(tmp_path):
         assert _put(s2, "/ns1/k2", "v2").event.node.value == "v2"
     finally:
         s2.stop()
+
+
+def test_membership_change_preserves_mesh_sharding(tmp_path):
+    """A committed ConfChange on a mesh-sharded engine must both
+    change the quorum and keep every state array mesh-placed (the
+    members-mask update flows through the jitted ops)."""
+    import jax
+
+    from etcd_tpu.parallel.mesh import group_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device (virtual) mesh")
+    mesh = group_mesh()
+    if G % mesh.shape["g"]:
+        pytest.skip("G not divisible by mesh g-axis")
+    s = _mk(tmp_path, spare_member_slots=1, mesh=mesh)
+    s.start()
+    try:
+        _put(s, "/mm/a", "1")
+        assert all(s.members_of(gi).sum() == 3 for gi in range(G))
+        s.add_member(3)
+        assert all(s.members_of(gi).sum() == 4 for gi in range(G))
+        _put(s, "/mm/b", "2")  # serving continues at 4 members
+        for st in s.mr.states:
+            assert len(st.members.sharding.device_set) == mesh.size
+            assert len(st.term.sharding.device_set) == mesh.size
+        s.remove_member(3)
+        assert all(s.members_of(gi).sum() == 3 for gi in range(G))
+        _put(s, "/mm/c", "3")
+    finally:
+        s.stop()
