@@ -247,15 +247,18 @@ def test_dist_frames_match_fused_multiraft():
                 (dist[0].committed_payload(gi, idx) or b"")
 
 
-def test_randomized_lossy_exchange_log_matching():
+@pytest.mark.parametrize("seed,m,steps", [(1234, 3, 120),
+                                          (777, 5, 150)])
+def test_randomized_lossy_exchange_log_matching(seed, m, steps):
     """Fuzz the frame layer the way the reference fuzzes its fake
     network (raft_test.go lossy topologies): random proposals,
     per-edge drops, competing campaigns, compactions — then assert
     the Log Matching safety property: every pair of members agrees
     on term AND payload for every index at or below both commits
-    (above both offsets)."""
-    rng = np.random.default_rng(1234)
-    g, m, cap = 4, 3, 96
+    (above both offsets).  The 5-member case exercises larger
+    quorums and more drop patterns."""
+    rng = np.random.default_rng(seed)
+    g, cap = 4, 96
     ms = make_cluster(g=g, m=m, cap=cap)
     elect(ms, 0)
     ms[0].propose(np.ones(g, np.int32), data=[[b""]] * g)
@@ -267,7 +270,7 @@ def test_randomized_lossy_exchange_log_matching():
                               replace=False).tolist())
 
     leader = 0
-    for step in range(120):
+    for step in range(steps):
         act = rng.random()
         if act < 0.55:
             n = rng.integers(0, 3, size=g).astype(np.int32)
