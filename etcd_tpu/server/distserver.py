@@ -108,7 +108,7 @@ class DistServer:
                  storage_backend: str = "auto",
                  live: int | None = None,
                  client_urls: list[str] | None = None,
-                 mesh=None):
+                 mesh=None, peer_tls=None):
         self.slot = slot
         self.g, self.m = g, len(peer_urls)
         # live member slots (< m leaves spare slots for runtime
@@ -121,6 +121,27 @@ class DistServer:
                 f"live={self.live} must be in 1..{self.m} "
                 f"(len(peer_urls))")
         self.peer_urls = list(peer_urls)
+        # Peer-tier TLS, same contexts as the classic sender/listener
+        # (utils/transport.py; client-cert auth required when the
+        # server context carries a CA)
+        self._peer_ssl_srv = None
+        self._peer_ssl_cli = None
+        tls_on = peer_tls is not None and not peer_tls.empty()
+        # scheme/TLS agreement up front: a mismatch would fail every
+        # handshake SILENTLY (_post_peer treats errors as dropped
+        # frames) — a dead cluster with nothing in the logs
+        https = {u.startswith("https://") for u in self.peer_urls}
+        if tls_on and https != {True}:
+            raise ValueError(
+                "peer TLS configured but --dist-peers has non-https "
+                "URLs")
+        if not tls_on and True in https:
+            raise ValueError(
+                "https --dist-peers requires peer TLS "
+                "(--peer-cert-file/--peer-key-file)")
+        if tls_on:
+            self._peer_ssl_srv = peer_tls.server_context()
+            self._peer_ssl_cli = peer_tls.client_context()
         if mesh is not None:
             # validate BEFORE any disk mutation: failing after the
             # fresh WAL is created would make the corrected retry
@@ -352,6 +373,14 @@ class DistServer:
         self._httpd = ThreadingHTTPServer((u.hostname, u.port),
                                           handler)
         self._httpd.daemon_threads = True
+        if self._peer_ssl_srv is not None:
+            # handshake deferred to the per-connection worker thread
+            # (first read triggers it): a stalled client must not
+            # block accept() and with it ALL peer raft traffic; the
+            # handler's socket timeout bounds the lazy handshake
+            self._httpd.socket = self._peer_ssl_srv.wrap_socket(
+                self._httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
         threading.Thread(target=self._httpd.serve_forever,
                          daemon=True).start()
         self._thread = threading.Thread(target=self.run, daemon=True)
@@ -592,7 +621,8 @@ class DistServer:
             headers={"Content-Type": "application/octet-stream"})
         try:
             with urllib.request.urlopen(
-                    req, timeout=timeout or 5.0) as resp:
+                    req, timeout=timeout or 5.0,
+                    context=self._peer_ssl_cli) as resp:
                 body = resp.read()
         except (urllib.error.URLError, OSError) as e:
             raise TimeoutError(f"forward failed: {e}") from None
@@ -864,7 +894,8 @@ class DistServer:
             headers={"Content-Type": "application/octet-stream"})
         try:
             with urllib.request.urlopen(
-                    req, timeout=self.post_timeout) as resp:
+                    req, timeout=self.post_timeout,
+                    context=self._peer_ssl_cli) as resp:
                 return resp.read()
         except (urllib.error.URLError, OSError, ConnectionError):
             return None
@@ -939,7 +970,8 @@ class DistServer:
             try:
                 with urllib.request.urlopen(
                         self.peer_urls[h] + "/mraft/snapshot",
-                        timeout=self.post_timeout * 5) as resp:
+                        timeout=self.post_timeout * 5,
+                        context=self._peer_ssl_cli) as resp:
                     blob = json.loads(resp.read().decode())
             except (urllib.error.URLError, OSError,
                     ValueError):
@@ -1040,6 +1072,9 @@ class DistServer:
 def _make_peer_handler(server: DistServer):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # per-connection socket timeout: bounds the deferred TLS
+        # handshake and any stalled peer read in the worker thread
+        timeout = 30
 
         def log_message(self, *a):  # quiet
             pass

@@ -170,3 +170,89 @@ def test_plain_http_unaffected():
         assert _RaftSink.received == [b"plain"]
     finally:
         httpd.shutdown()
+
+
+def test_dist_cluster_over_https_with_client_cert_auth(certs,
+                                                       tmp_path):
+    """The distributed tier's peer frames ride HTTPS with REQUIRED
+    client-cert auth (the same TLSInfo contexts as the classic
+    sender/listener): a 3-host cluster bootstraps, commits, and
+    replicates entirely over TLS."""
+    import time as _time
+
+
+    from conftest import bootstrap_dist_leader, free_ports
+    from etcd_tpu.server.distserver import DistServer
+    from etcd_tpu.wire.requests import Request
+
+    tls = TLSInfo(cert_file=str(certs / "srv.crt"),
+                  key_file=str(certs / "srv.key"),
+                  ca_file=str(certs / "ca.crt"))
+    ports = free_ports(3)
+    urls = [f"https://127.0.0.1:{p}" for p in ports]
+    servers = []
+    try:
+        for slot in range(3):
+            s = DistServer(str(tmp_path / f"d{slot}"), slot=slot,
+                           peer_urls=urls, g=4, cap=64,
+                           tick_interval=0.05, post_timeout=2.0,
+                           election=60, peer_tls=tls)
+            s.start()
+            servers.append(s)
+        bootstrap_dist_leader(servers)
+        rid = [100]
+
+        def put(srv, key, val):
+            rid[0] += 1
+            return srv.do(Request(method="PUT", id=rid[0], path=key,
+                                  val=val), timeout=15.0)
+
+        ev = put(servers[0], "/tls/key", "secure")
+        assert ev.event.node.value == "secure"
+        deadline = _time.time() + 20
+        while _time.time() < deadline:
+            try:
+                if all(s.store.get("/tls/key", False, False)
+                       .node.value == "secure" for s in servers[1:]):
+                    break
+            except Exception:
+                pass
+            _time.sleep(0.1)
+        for i, s in enumerate(servers[1:], 1):
+            assert s.store.get("/tls/key", False, False) \
+                .node.value == "secure", f"replica {i}"
+
+        # a client WITHOUT a cert is rejected by the peer listener
+        import ssl
+        import urllib.error
+        import urllib.request
+
+        anon = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        anon.check_hostname = False
+        anon.verify_mode = ssl.CERT_NONE
+        with pytest.raises((urllib.error.URLError, OSError,
+                            ssl.SSLError)):
+            urllib.request.urlopen(urls[0] + "/mraft/snapshot",
+                                   timeout=5, context=anon).read()
+    finally:
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+
+
+def test_dist_peer_scheme_tls_mismatch_rejected(certs, tmp_path):
+    """A scheme/TLS mismatch would fail every handshake silently
+    (dropped-frame contract) — it must be rejected at construction."""
+    from etcd_tpu.server.distserver import DistServer
+
+    tls = TLSInfo(cert_file=str(certs / "srv.crt"),
+                  key_file=str(certs / "srv.key"))
+    with pytest.raises(ValueError, match="non-https"):
+        DistServer(str(tmp_path / "a"), slot=0,
+                   peer_urls=["http://a:1", "http://b:1"],
+                   g=4, peer_tls=tls)
+    with pytest.raises(ValueError, match="requires peer TLS"):
+        DistServer(str(tmp_path / "b"), slot=0,
+                   peer_urls=["https://a:1", "https://b:1"], g=4)
