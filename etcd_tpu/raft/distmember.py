@@ -293,10 +293,23 @@ class DistMember:
             for j in range(int(b.n_ents[gi])):
                 self.payloads[gi][int(b.prev_idx[gi]) + 1 + j] = \
                     b.payloads[gi][j]
+        # A need_snap lane acks POSITIVELY at its commit (the
+        # reference's handleSnapshot reply, raft.go:418-424): the
+        # follower durably holds everything at or below its commit,
+        # and after a snapshot install this is what advances the
+        # leader's match/next past its compaction point.  The reject
+        # hint cannot do it — reject repair only moves next_ DOWN
+        # (backtracking), so without this the leader re-flags
+        # need_snap forever and the follower loops snapshot pulls
+        # (found by the chaos drill).
+        need = np.asarray(b.need_snap) & np.asarray(cur)
+        commit_np = np.asarray(st.commit, dtype=np.int32)
         return AppendResp(
-            sender=self.slot, term=np.asarray(st.term), ok=ok_np,
-            acked=(b.prev_idx + b.n_ents).astype(np.int32),
-            hint=np.asarray(st.commit),
+            sender=self.slot, term=np.asarray(st.term),
+            ok=ok_np | need,
+            acked=np.where(need, commit_np,
+                           b.prev_idx + b.n_ents).astype(np.int32),
+            hint=commit_np,
             active=np.asarray(cur) | (np.asarray(b.need_snap)
                                       & np.asarray(active)))
 

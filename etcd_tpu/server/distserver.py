@@ -446,26 +446,37 @@ class DistServer:
         self.wal.save(HardState(term=self.raft_term, vote=0,
                                 commit=self.seq), ents)
 
-    def _persist_ballot(self) -> None:
-        """Durable term/vote BEFORE any vote or campaign leaves this
-        host (the HardState analog, wal.go:35-39) — only when it
-        actually changed."""
+    def _ballot_record(self) -> list[Entry]:
+        """Allocate (seq-ordered) the ballot record for a changed
+        term/vote, or [] when unchanged.  Allocation happens HERE so
+        a caller that prepends this to its entry batch gets one
+        seq-contiguous WAL write — out-of-order seqs (a later seq on
+        disk before earlier ones reads as an index gap on restart)
+        are structurally unrepresentable."""
         st = self.mr.state
         terms = np.asarray(st.term, np.int32)
         votes = np.asarray(st.vote, np.int32)
         if (np.array_equal(terms, self._ballot[0])
                 and np.array_equal(votes, self._ballot[1])):
-            return
+            return []
         self._ballot = (terms.copy(), votes.copy())
         self.raft_term = max(self.raft_term, int(terms.max()))
         self.seq += 1
-        self.wal.save(
-            HardState(term=self.raft_term, vote=0, commit=self.seq),
-            [Entry(index=self.seq, term=self.raft_term,
-                   data=GroupEntry(
-                       kind=K_BALLOT,
-                       payload=terms.tobytes() + votes.tobytes())
-                   .marshal())])
+        return [Entry(index=self.seq, term=self.raft_term,
+                      data=GroupEntry(
+                          kind=K_BALLOT,
+                          payload=terms.tobytes() + votes.tobytes())
+                      .marshal())]
+
+    def _persist_ballot(self) -> None:
+        """Durable term/vote BEFORE any vote or campaign leaves this
+        host (the HardState analog, wal.go:35-39) — only when it
+        actually changed."""
+        rec = self._ballot_record()
+        if rec:
+            self.wal.save(
+                HardState(term=self.raft_term, vote=0,
+                          commit=self.seq), rec)
 
     def _entry_records(self, gis, base, items) -> list[Entry]:
         """WAL records for entries appended at this host."""
@@ -494,10 +505,14 @@ class DistServer:
             if isinstance(msg, AppendBatch):
                 self.server_stats.recv_append()
                 resp = self.mr.handle_append(msg)
-                recs = []
-                ok = resp.ok
-                terms = self.mr.terms()
-                for gi in np.nonzero(ok)[0]:
+                # the ballot record (if the term changed in this
+                # frame) leads the batch: _ballot_record allocates
+                # seqs in order, so one seq-contiguous WAL write
+                # carries ballot + entries (a later seq on disk
+                # before earlier ones reads as an index gap on the
+                # next restart — found by the chaos drill)
+                recs = self._ballot_record()
+                for gi in np.nonzero(resp.ok)[0]:
                     for j in range(int(msg.n_ents[gi])):
                         self.seq += 1
                         recs.append(Entry(
@@ -508,7 +523,6 @@ class DistServer:
                                 gterm=int(msg.ent_terms[gi, j]),
                                 payload=msg.payloads[gi][j])
                             .marshal()))
-                self._persist_ballot()
                 self._persist(recs)
                 if bool(np.any(msg.need_snap & msg.active)):
                     self._need_pull = True

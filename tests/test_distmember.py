@@ -179,10 +179,23 @@ def test_need_snap_flag_past_compaction():
     terms = ms[0].commit_terms()
     inst = ms[2].install_snapshot(frontier, terms)
     assert inst.all()
-    # leader learns the new match from the next reject/hint cycle
+    # ONE response repairs the leader: the need_snap lane acks
+    # positively at its commit (raft.go:418-424's handleSnapshot
+    # reply), advancing match/next past the compaction point —
+    # merely re-reaching the frontier would also hold for an
+    # install LOOP, so assert the flag clears and real appends
+    # resume (chaos-drill regression)
+    replicate(ms, 0)
+    assert (np.asarray(ms[0].state.match)[:, 2]
+            >= np.asarray(frontier)).all()
+    b = ms[0].build_append(2)
+    assert b is None or not b.need_snap.any()
+    ms[0].propose(np.ones(G, np.int32),
+                  data=[[b"post"] for _ in range(G)])
     replicate(ms, 0)
     replicate(ms, 0)
-    assert (ms[2].commit_index() >= frontier).all()
+    assert (ms[2].commit_index() > frontier).all()
+    assert ms[2].committed_payload(0, int(frontier[0]) + 1) == b"post"
 
 
 def test_partial_mask_campaign():

@@ -558,3 +558,48 @@ def test_mesh_sharded_dist_cluster(tmp_path):
                 s.stop()
             except Exception:
                 pass
+
+
+def test_append_with_term_change_keeps_wal_contiguous(tmp_path):
+    """Chaos-drill regression: a frame carrying BOTH a term change
+    and entries (a new leader's first append after failover) must
+    write WAL records in seq order — the ballot record is persisted
+    immediately inside _persist_ballot, so it must be allocated
+    BEFORE the entry records.  Pre-fix the stream went
+    [..., ballot(n+k+1), ent(n+1..n+k), ...] and every later restart
+    died with 'entry index gap'."""
+    from etcd_tpu.wire.distmsg import AppendBatch
+
+    g = 4
+    urls = [f"http://127.0.0.1:{p}" for p in free_ports_n(2)]
+    s = DistServer(str(tmp_path / "d0"), slot=0, peer_urls=urls,
+                   g=g, cap=64, tick_interval=0.05)
+    payload = Request(method="PUT", id=9, path="/x", val="v").marshal()
+    term = np.full(g, 5, np.int32)  # far above the fresh server's
+    frame = AppendBatch(
+        sender=1, term=term,
+        prev_idx=np.zeros(g, np.int32),
+        prev_term=np.zeros(g, np.int32),
+        n_ents=np.ones(g, np.int32),
+        commit=np.zeros(g, np.int32),
+        active=np.ones(g, bool),
+        need_snap=np.zeros(g, bool),
+        ent_terms=np.full((g, 1), 5, np.int32),
+        payloads=[[payload] for _ in range(g)])
+    s.handle_frame(frame.marshal())
+    s.wal.close()
+
+    # the on-disk stream must be index-contiguous from 0
+    from etcd_tpu.wal import WAL
+
+    w = WAL.open_at_index(str(tmp_path / "d0" / "wal"), 0)
+    _, _, ents = w.read_all()  # raises 'entry index gap' pre-fix
+    w.close()
+    idxs = [e.index for e in ents]
+    assert idxs == list(range(len(idxs)))
+
+    # and a fresh server restarts from the same dir
+    s2 = DistServer(str(tmp_path / "d0"), slot=0, peer_urls=urls,
+                    g=g, cap=64, tick_interval=0.05)
+    assert (s2.mr.terms() == 5).all()
+    s2.wal.close()
