@@ -93,8 +93,20 @@ def restart_node(id: int, election: int, heartbeat: int,
     r = Raft(id, [], election, heartbeat)
     if snapshot is not None:
         r.restore(snapshot)
+    if ents:
+        # an empty replay must keep the restored dummy slot (load
+        # replaces the whole entry array)
+        r.load_ents(ents)
+    # the reference's loadState guard (raft.go): a commit outside the
+    # loaded log marks corrupt/mismatched storage — fail LOUDLY here
+    # rather than restart as a zombie that silently skips its whole
+    # apply window
+    last = r.raft_log.last_index()
+    if st.commit > last:
+        raise ValueError(
+            f"restart state.commit {st.commit} is past the loaded "
+            f"log's last index {last} (corrupt or truncated storage)")
     r.load_state(st)
-    r.load_ents(ents)
     return Node(r)
 
 

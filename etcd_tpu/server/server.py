@@ -450,14 +450,26 @@ def _replay_wal_raw(waldir: str, index: int, backend: str):
                 log.info("etcdserver: device replay of %d entries "
                          "(%d bytes)", len(block), size)
                 return w, md, hard_state, block
-            except Exception:
-                if backend == "tpu":
+            except Exception as e:
+                # a crash-torn tail must heal on EVERY backend — the
+                # torn bytes were never acked — so even strict tpu
+                # mode falls through to the host path's repair for
+                # that case (each lane words the EOF differently:
+                # host decoder "unexpected EOF", python scan
+                # "truncated frame/record", native scan "truncated
+                # stream")
+                torn = ("unexpected EOF" in str(e)
+                        or "truncated" in str(e))
+                if backend == "tpu" and not torn:
                     raise
                 log.warning("etcdserver: device replay failed; "
                             "falling back to host path", exc_info=True)
     with tracer.span("replay.host"):
         w = WAL.open_at_index(waldir, index)
-        md, hard_state, ents = w.read_all()
+        # server restarts tolerate a crash-torn tail (unacked by
+        # construction — acks only follow fsync); the device lane
+        # above raises on one, and auto mode then lands here
+        md, hard_state, ents = w.read_all(repair=True)
     return w, md, hard_state, ents
 
 

@@ -19,6 +19,7 @@ durable read/write seam shared by both paths.
 
 from __future__ import annotations
 
+import logging
 import os
 import struct
 from typing import BinaryIO
@@ -32,6 +33,8 @@ from .errors import (
     MetadataConflictError,
     WALError,
 )
+
+log = logging.getLogger(__name__)
 
 # record types (reference wal/wal.go:35-39)
 METADATA_TYPE = 1
@@ -128,6 +131,9 @@ class _Decoder:
         self.files = files
         self.fi = 0
         self.crc = Digest(0)
+        # (file index, offset) where the NEXT record starts — the
+        # truncation point for torn-tail repair
+        self.good = (0, 0)
 
     def _read(self, n: int) -> bytes:
         """ReadFull across the file chain; b'' at a clean stream end."""
@@ -147,9 +153,18 @@ class _Decoder:
     def decode(self) -> Record | None:
         """Next record, or None at a clean EOF.  A partial trailing
         record raises (the reference surfaces io.ErrUnexpectedEOF)."""
-        header = self._read(8)
-        if len(header) == 0:
+        # advance past exhausted files so the recorded record-start
+        # position is meaningful for repair
+        while self.fi < len(self.files):
+            probe = self.files[self.fi].read(1)
+            if probe:
+                self.files[self.fi].seek(-1, 1)
+                break
+            self.fi += 1
+        if self.fi >= len(self.files):
             return None
+        self.good = (self.fi, self.files[self.fi].tell())
+        header = self._read(8)
         if len(header) < 8:
             raise WALError("unexpected EOF in record length")
         (length,) = _LEN_STRUCT.unpack(header)
@@ -272,16 +287,50 @@ class WAL:
 
     # -- read --------------------------------------------------------------
 
-    def read_all(self) -> tuple[bytes | None, HardState, list[Entry]]:
+    def read_all(self, repair: bool = False
+                 ) -> tuple[bytes | None, HardState, list[Entry]]:
         """Drain the WAL; afterwards it accepts appends
-        (reference wal/wal.go:164-216)."""
+        (reference wal/wal.go:164-216).
+
+        ``repair=True`` tolerates a TORN TAIL — a final record cut
+        mid-write by a crash (unexpected EOF): the stream is
+        truncated at the last complete record and replay succeeds
+        with what is durable.  Safe because acks only follow fsync,
+        so torn bytes were never acknowledged to anyone.  The
+        reference's 0.5 snapshot log.Fatals here (server.go:156);
+        later etcd grew exactly this repair.  Default False keeps the
+        strict parity behavior (corruption detection tests).  Any
+        OTHER corruption — CRC mismatch, index gap, a torn record
+        followed by more data — still raises."""
         if self.decoder is None:
             raise WALError("wal not in read mode")
         metadata: bytes | None = None
         state = HardState()
         ents: list[Entry] = []
 
-        while (rec := self.decoder.decode()) is not None:
+        repaired = False
+
+        def decode_or_repair():
+            nonlocal repaired
+            try:
+                return self.decoder.decode()
+            except WALError as e:
+                # torn tail = unexpected EOF: the failing record is
+                # by construction the stream's last bytes (the chain
+                # is exhausted mid-record)
+                if repair and "unexpected EOF" in str(e):
+                    fi, off = self.decoder.good
+                    path = self.decoder.files[fi].name
+                    os.truncate(path, off)
+                    log.warning(
+                        "wal: repaired torn tail: truncated %s at "
+                        "byte %d (%s)", os.path.basename(path), off,
+                        e)
+                    repaired = True
+                    return None
+                raise
+
+        while (rec := decode_or_repair()) is not None:
             if rec.type == ENTRY_TYPE:
                 e = Entry.unmarshal(rec.data or b"")
                 if e.index >= self.ri:
@@ -316,6 +365,19 @@ class WAL:
         if self.enti < self.ri:
             raise IndexNotFoundError(
                 f"last entry {self.enti} < requested {self.ri}")
+
+        if repaired and state.commit > self.enti:
+            # WALs written before the entries-before-state order (or
+            # a tear inside the entry run) can leave a surviving
+            # state record whose commit points past the surviving
+            # entries; an unclamped commit makes the restarted node
+            # skip its whole apply window (a silent zombie).  The
+            # torn suffix was never acked, so clamping is safe.
+            log.warning("wal: repaired tail — clamping commit %d to "
+                        "last surviving entry %d", state.commit,
+                        self.enti)
+            state = HardState(term=state.term, vote=state.vote,
+                              commit=self.enti)
 
         # close decoder, disable reading; chain the encoder's crc
         last_crc = self.decoder.last_crc()
@@ -378,7 +440,9 @@ class WAL:
 
     def save(self, st: HardState, ents: list[Entry]) -> None:
         """HardState + entries + fsync — the Ready-contract durability
-        step (reference wal/wal.go:281-288)."""
+        step (reference wal/wal.go:281-288, state record first for
+        byte-layout parity; read_all's repair clamp covers the
+        state-before-entries tear case)."""
         self.save_state(st)
         for e in ents:
             self.save_entry(e)

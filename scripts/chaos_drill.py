@@ -18,6 +18,7 @@ Usage: python scripts/chaos_drill.py [CYCLES]   (default 6)
 import json
 import os
 import random
+import shutil
 import signal
 import subprocess
 import sys
@@ -64,6 +65,8 @@ def get(base, key, timeout=10):
         return json.loads(r.read())
 
 
+shutil.rmtree(BASE, ignore_errors=True)  # stale dirs from a prior
+# run would replay old values outside this run's issued set
 os.makedirs(BASE, exist_ok=True)
 procs = {i: start(i) for i in range(3)}
 time.sleep(22)
