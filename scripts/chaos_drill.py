@@ -31,6 +31,7 @@ BASE = "/tmp/chaosd"
 PEERS = [f"http://127.0.0.1:1785{i}" for i in range(3)]
 CLIENT = [f"http://127.0.0.1:1486{i}" for i in range(3)]
 CYCLES = int(sys.argv[1]) if len(sys.argv) > 1 else 6
+tear = "--tear" in sys.argv
 
 env = dict(os.environ)
 env.update(JAX_PLATFORMS="cpu", ETCD_JAX_PLATFORMS="cpu",
@@ -85,6 +86,16 @@ try:
         survivors = [i for i in range(3) if i != victim]
         procs[victim].send_signal(signal.SIGKILL)
         procs[victim].wait()
+        if tear and rng.random() < 0.7:
+            # simulate the kill landing mid-write: tear bytes off the
+            # victim's newest WAL segment (restart must repair)
+            wd = f"{BASE}/d{victim}/wal"
+            seg = os.path.join(wd, sorted(os.listdir(wd))[-1])
+            cut = rng.randrange(1, 40)
+            if os.path.getsize(seg) > cut + 64:
+                os.truncate(seg, os.path.getsize(seg) - cut)
+                print(f"cycle {cycle}: tore {cut} bytes off "
+                      f"s{victim}'s WAL tail", flush=True)
         t_end = time.time() + 12
         ok = fail = 0
         while time.time() < t_end:
