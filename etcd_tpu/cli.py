@@ -28,6 +28,7 @@ from .utils.flags import (
     PROXY_VALUE_OFF,
     PROXY_VALUE_READONLY,
     parse_cors,
+    parse_ip_address_port,
     set_flags_from_env,
     urls_from_flags,
     validate_urls,
@@ -112,14 +113,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "under the cross-host tier; --cohosted-groups "
                         "must divide by the mesh's group axis; 0 = "
                         "single device)")
-    # v0.4.6 back-compat (main.go:87-98)
-    p.add_argument("--addr", default=None,
+    # v0.4.6 back-compat (main.go:87-98); values are validated as
+    # strict IP:port (pkg/flags/ipaddressport.go semantics)
+    p.add_argument("--addr", default=None, type=parse_ip_address_port,
                    help="DEPRECATED: Use --advertise-client-urls instead.")
     p.add_argument("--bind-addr", default=None,
+                   type=parse_ip_address_port,
                    help="DEPRECATED: Use --listen-client-urls instead.")
     p.add_argument("--peer-addr", default=None,
+                   type=parse_ip_address_port,
                    help="DEPRECATED: Use --advertise-peer-urls instead.")
     p.add_argument("--peer-bind-addr", default=None,
+                   type=parse_ip_address_port,
                    help="DEPRECATED: Use --listen-peer-urls instead.")
     for f in IGNORED_FLAGS:
         p.add_argument(f"--{f}", nargs="?", const="", default=None,

@@ -8,6 +8,7 @@ Precedence: explicit flags > ETCD_* environment variables > defaults
 from __future__ import annotations
 
 import argparse
+import ipaddress
 import logging
 import os
 import urllib.parse
@@ -56,11 +57,17 @@ def parse_cors(s: str) -> set[str]:
 
 
 def parse_ip_address_port(s: str) -> str:
-    """DEPRECATED addr-style flag value host:port
-    (pkg/flags/ipaddressport.go)."""
-    host, _, port = s.partition(":")
-    if not port or not port.isdigit():
+    """DEPRECATED addr-style flag value IP:port
+    (pkg/flags/ipaddressport.go — the host must be a literal IPv4
+    address and the port numeric; hostnames, schemes, and unix
+    sockets are rejected)."""
+    host, sep, port = s.partition(":")
+    if not sep or not port or not (port.isascii() and port.isdigit()):
         raise ValueError(f"bad IP address:port: {s}")
+    try:
+        ipaddress.IPv4Address(host)
+    except ValueError:
+        raise ValueError(f"bad IP address:port: {s}") from None
     return f"{host}:{port}"
 
 

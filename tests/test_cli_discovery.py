@@ -10,6 +10,7 @@ from etcd_tpu.discovery import Discoverer, DiscoveryError
 from etcd_tpu.discovery import discovery as disc_mod
 from etcd_tpu.utils.flags import (
     parse_cors,
+    parse_ip_address_port,
     set_flags_from_env,
     urls_from_flags,
     validate_urls,
@@ -287,3 +288,20 @@ def test_discover_orders_peers_by_created_index():
                    client=FakeClient(len(nodes), nodes))
     got = d.discover().split(",")
     assert got == [f"n{i}=http://h{i}:7001" for i in sorted(idxs)]
+
+
+# reference pkg/flags/ipaddressport_test.go TestIPAddressPortSet
+@pytest.mark.parametrize("good", ["1.2.3.4:8080", "10.1.1.1:80"])
+def test_ip_address_port_good(good):
+    assert parse_ip_address_port(good) == good
+
+
+@pytest.mark.parametrize("bad", [
+    ":4001", "127.0:8080", "123:456",        # bad IP
+    "127.0.0.1:foo", "127.0.0.1:",           # bad port
+    "unix://", "unix://tmp/etcd.sock",       # unix sockets
+    "somewhere", "234#$", "file://foo/bar", "http://hello",
+])
+def test_ip_address_port_bad(bad):
+    with pytest.raises(ValueError):
+        parse_ip_address_port(bad)
