@@ -134,9 +134,13 @@ class Cluster(dict):
         """Parse 'name1=http://...,name2=http://...'
         (reference cluster.go:66-85)."""
         self.clear()
-        v = urllib.parse.parse_qs(s.replace(",", "&"), strict_parsing=False)
+        # keep_blank_values so "name=" surfaces to the empty-URL
+        # guard below instead of silently parsing to an empty cluster
+        v = urllib.parse.parse_qs(s.replace(",", "&"),
+                                  strict_parsing=False,
+                                  keep_blank_values=True)
         for name, urls in v.items():
-            if not urls or urls[0] == "":
+            if not urls or any(u == "" for u in urls):
                 raise ValueError(f"empty URL given for {name!r}")
             m = new_member(name, sorted(urls))
             self.add(m)

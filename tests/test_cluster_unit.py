@@ -1,0 +1,93 @@
+"""Cluster membership unit tables translated from the reference
+etcdserver/cluster_test.go (Find/Pick/IDs/URLs/Set/Add matrices)."""
+
+import pytest
+
+from etcd_tpu.server.cluster import Cluster, Member
+
+
+def _member(id, name="", peer_urls=None):
+    return Member(id=id, name=name, peer_urls=peer_urls or [])
+
+
+# reference cluster_test.go TestClusterFind
+@pytest.mark.parametrize(
+    "find,mems,match",
+    [
+        ("node1", [(1, "node1")], True),
+        ("foobar", [], False),
+        ("node2", [(1, "node1"), (2, "node2")], True),
+        ("node3", [(1, "node1"), (2, "node2")], False),
+    ],
+)
+def test_cluster_find_name(find, mems, match):
+    c = Cluster()
+    for id, name in mems:
+        c.add(_member(id, name))
+    m = c.find_name(find)
+    assert (m is not None) == match
+    if match:
+        assert m.name == find
+
+
+# reference cluster_test.go TestClusterPick
+def test_cluster_pick():
+    c = Cluster()
+    many = ["abc", "def", "ghi", "jkl", "mno", "pqr", "stu"]
+    c.add(_member(1, "a", many))
+    c.add(_member(2, "b", ["xyz"]))
+    c.add(_member(3, "c", []))
+    for _ in range(100):
+        assert c.pick(1) in many
+    assert c.pick(2) == "xyz"
+    assert c.pick(3) == ""
+    assert c.pick(4) == ""  # unknown member
+
+
+# reference cluster_test.go TestClusterIDs
+def test_cluster_ids_sorted():
+    c = Cluster()
+    for id in (4, 1, 3):
+        c.add(_member(id, f"n{id}"))
+    assert c.ids() == [1, 3, 4]
+
+
+# reference cluster_test.go TestClusterPeerURLs / TestClusterClientURLs
+def test_cluster_urls_all_sorted():
+    c = Cluster()
+    c.add(Member(id=1, name="a", peer_urls=["http://b:7001"],
+                 client_urls=["http://b:4001"]))
+    c.add(Member(id=2, name="b", peer_urls=["http://a:7001"],
+                 client_urls=["http://a:4001"]))
+    assert c.peer_urls_all() == ["http://a:7001", "http://b:7001"]
+    assert c.client_urls_all() == ["http://a:4001", "http://b:4001"]
+
+
+# reference cluster_test.go TestClusterAddBad
+def test_cluster_add_duplicate_id_rejected():
+    c = Cluster()
+    c.add(_member(1, "a"))
+    with pytest.raises(ValueError, match="identical ID"):
+        c.add(_member(1, "b"))
+
+
+# reference cluster_test.go TestClusterSetBad
+@pytest.mark.parametrize("bad", [
+    "node1=",                          # empty URL
+    "node1=http://a:2380,node1=",      # blank among valid URLs
+])
+def test_cluster_set_bad(bad):
+    c = Cluster()
+    with pytest.raises(ValueError):
+        c.set_from_string(bad)
+
+
+def test_cluster_roundtrip_string():
+    # str(cluster) re-parses to the same membership (cluster.go:87-99)
+    c = Cluster()
+    c.set_from_string("n1=http://a:7001,n2=http://b:7001,"
+                      "n1=http://c:7001")
+    c2 = Cluster()
+    c2.set_from_string(str(c))
+    assert str(c2) == str(c)
+    assert c2.ids() == c.ids()
