@@ -1,0 +1,61 @@
+"""EventHistory ring-buffer unit tests translated from the reference
+store/event_test.go (TestEventQueue / TestScanHistory /
+TestFullEventQueue)."""
+
+import pytest
+
+from etcd_tpu.store.event import new_event
+from etcd_tpu.store.event_history import EventHistory
+from etcd_tpu.utils.errors import ECODE_EVENT_INDEX_CLEARED, EtcdError
+
+
+def _ev(key, index):
+    return new_event("create", key, index, index)
+
+
+# reference event_test.go TestEventQueue
+def test_event_queue_wraps_at_capacity():
+    eh = EventHistory(100)
+    for i in range(200):  # 2x capacity: the ring wraps
+        eh.add_event(_ev("/foo", i))
+    # the surviving window is the NEWEST capacity events
+    assert eh.start_index == 100
+    assert eh.last_index == 199
+
+
+# reference event_test.go TestScanHistory
+def test_scan_history():
+    eh = EventHistory(100)
+    for i, key in enumerate(
+            ["/foo", "/foo/bar", "/foo/foo", "/foo/bar/bar",
+             "/foo/foo/foo"], start=1):
+        eh.add_event(_ev(key, i))
+    e = eh.scan("/foo", False, 1)
+    assert e is not None and e.index() == 1
+    e = eh.scan("/foo/bar", False, 1)
+    assert e is not None and e.index() == 2
+    e = eh.scan("/foo/bar", True, 3)
+    assert e is not None and e.index() == 4
+    e = eh.scan("/foo/bar", True, 6)  # future index
+    assert e is None
+
+
+# reference event_test.go TestFullEventQueue
+def test_full_event_queue_scan_under_wrap():
+    eh = EventHistory(10)
+    for i in range(1000):
+        eh.add_event(_ev("/foo", i))
+        if i > 0:
+            # i-1 is always inside the 10-event window right after
+            # inserting i; a cleared error here would be a wrap bug
+            e = eh.scan("/foo", True, i - 1)
+            assert e is not None
+
+
+def test_scan_before_window_raises_cleared():
+    eh = EventHistory(5)
+    for i in range(20):
+        eh.add_event(_ev("/k", i))
+    with pytest.raises(EtcdError) as ei:
+        eh.scan("/k", False, 3)  # long compacted
+    assert ei.value.error_code == ECODE_EVENT_INDEX_CLEARED
