@@ -271,3 +271,28 @@ def test_restart_heals_crash_torn_wal_tail(tmp_path):
         assert _do_real(s2, "set", "/soak/after", "crash", None)
     finally:
         s2.stop()
+
+
+def test_soak_cluster_of_3_matches_model():
+    """The classic in-process 3-member cluster (TestClusterOf3's
+    fixture shape) under the same sequential spec: per-op agreement
+    on the leader and replica convergence on all members."""
+    from test_server import make_cluster, stop_cluster, wait_for_leader
+
+    servers = make_cluster(3)
+    lead = wait_for_leader(servers)
+    rng = random.Random(424242)
+    model = {}
+    try:
+        _soak_steps(lead, rng, KEYS, model, 200)
+        assert _view(lead, "/soak") == model
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if all(_view(s, "/soak") == model
+                   for s in servers.values()):
+                break
+            time.sleep(0.1)
+        for i, s in servers.items():
+            assert _view(s, "/soak") == model, f"member {i} diverged"
+    finally:
+        stop_cluster(servers)
