@@ -224,22 +224,19 @@ def start_dist(args, explicit: set[str]) -> int:
         return 1
     peer_tls = TLSInfo(args.peer_cert_file, args.peer_key_file,
                        args.peer_ca_file)
-    https = {u.startswith("https://") for u in peers}
-    if not peer_tls.empty() and https != {True}:
-        log.error("peer TLS configured but --dist-peers has "
-                  "non-https URLs")
+    try:
+        # peer-TLS/https scheme agreement is validated by the
+        # DistServer constructor (the single copy of that rule)
+        s = DistServer(data_dir, slot=args.dist_slot, peer_urls=peers,
+                       g=g, name=f"{args.name}-{args.dist_slot}",
+                       snap_count=args.snapshot_count,
+                       storage_backend=args.storage_backend,
+                       client_urls=list(acurls), mesh=mesh,
+                       peer_tls=peer_tls if not peer_tls.empty()
+                       else None)
+    except ValueError as e:
+        log.error("%s", e)
         return 1
-    if peer_tls.empty() and True in https:
-        log.error("https --dist-peers requires "
-                  "--peer-cert-file/--peer-key-file")
-        return 1
-    s = DistServer(data_dir, slot=args.dist_slot, peer_urls=peers,
-                   g=g, name=f"{args.name}-{args.dist_slot}",
-                   snap_count=args.snapshot_count,
-                   storage_backend=args.storage_backend,
-                   client_urls=list(acurls), mesh=mesh,
-                   peer_tls=peer_tls if not peer_tls.empty()
-                   else None)
     s.start()
     if args.dist_slot == 0 and s.fresh:
         # slot 0 bootstraps leadership for a BRAND-NEW cluster only

@@ -30,20 +30,31 @@ _tried = False
 
 
 class NativeError(RuntimeError):
-    pass
+    """Native-tier failure; ``code`` carries the C return code so
+    wrappers can map classes of failure (torn tail, crc) onto the
+    repo's typed exception vocabulary without message matching."""
 
+    def __init__(self, msg: str, code: int = 0):
+        super().__init__(msg)
+        self.code = code
+
+
+TRUNCATED = -1
+PROTO_ERR = -2
+CAPACITY = -3
+CRC_MISMATCH = -4
 
 _ERRORS = {
-    -1: "truncated stream",
-    -2: "proto parse error",
-    -3: "capacity exceeded",
-    -4: "crc mismatch",
+    TRUNCATED: "truncated stream",
+    PROTO_ERR: "proto parse error",
+    CAPACITY: "capacity exceeded",
+    CRC_MISMATCH: "crc mismatch",
 }
 
 
 def _check(rc: int) -> int:
     if rc < 0:
-        raise NativeError(_ERRORS.get(rc, f"native error {rc}"))
+        raise NativeError(_ERRORS.get(rc, f"native error {rc}"), rc)
     return rc
 
 

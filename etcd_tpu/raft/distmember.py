@@ -311,7 +311,8 @@ class DistMember:
                            b.prev_idx + b.n_ents).astype(np.int32),
             hint=commit_np,
             active=np.asarray(cur) | (np.asarray(b.need_snap)
-                                      & np.asarray(active)))
+                                      & np.asarray(active)),
+            appended=ok_np)
 
     def install_snapshot(self, frontier: np.ndarray,
                          terms: np.ndarray,

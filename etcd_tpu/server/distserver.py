@@ -512,7 +512,7 @@ class DistServer:
                 # before earlier ones reads as an index gap on the
                 # next restart — found by the chaos drill)
                 recs = self._ballot_record()
-                for gi in np.nonzero(resp.ok)[0]:
+                for gi in np.nonzero(resp.appended)[0]:
                     for j in range(int(msg.n_ents[gi])):
                         self.seq += 1
                         recs.append(Entry(

@@ -26,7 +26,7 @@ from ..store import Store, Watcher
 from ..utils.errors import EtcdError
 from ..utils.trace import tracer
 from ..utils.wait import Wait
-from ..wal import WAL, exist as wal_exist
+from ..wal import WAL, TornTailError, exist as wal_exist
 from ..wire import (
     CONF_CHANGE_ADD_NODE,
     CONF_CHANGE_REMOVE_NODE,
@@ -454,13 +454,11 @@ def _replay_wal_raw(waldir: str, index: int, backend: str):
                 # a crash-torn tail must heal on EVERY backend — the
                 # torn bytes were never acked — so even strict tpu
                 # mode falls through to the host path's repair for
-                # that case (each lane words the EOF differently:
-                # host decoder "unexpected EOF", python scan
-                # "truncated frame/record", native scan "truncated
-                # stream")
-                torn = ("unexpected EOF" in str(e)
-                        or "truncated" in str(e))
-                if backend == "tpu" and not torn:
+                # that case; all three scanners raise the same typed
+                # TornTailError (wal/errors.py), so this matches on
+                # type, never on message text
+                if backend == "tpu" and not isinstance(
+                        e, TornTailError):
                     raise
                 log.warning("etcdserver: device replay failed; "
                             "falling back to host path", exc_info=True)

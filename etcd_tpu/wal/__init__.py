@@ -12,6 +12,7 @@ from .errors import (
     FileNotFoundError_,
     IndexNotFoundError,
     MetadataConflictError,
+    TornTailError,
     WALError,
 )
 from .wal import (
@@ -43,4 +44,5 @@ __all__ = [
     "FileNotFoundError_",
     "IndexNotFoundError",
     "CRCMismatchError",
+    "TornTailError",
 ]

@@ -14,11 +14,23 @@ __all__ = [
     "FileNotFoundError_",
     "IndexNotFoundError",
     "CRCMismatchError",
+    "TornTailError",
 ]
 
 
 class WALError(Exception):
     pass
+
+
+class TornTailError(WALError):
+    """The stream ends mid-record (the reference's io.ErrUnexpectedEOF
+    lane, wal/decoder.go:30-35): every byte from the failing record's
+    start to the end of the file chain belongs to the torn record.
+
+    All three scanners (host decoder, python scan, native scan) raise
+    this exact type so strict-mode replay policy matches on the type,
+    never on message text.
+    """
 
 
 class CRCMismatchError(WALError, WireCRCMismatchError):

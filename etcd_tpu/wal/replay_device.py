@@ -39,6 +39,7 @@ from .errors import (
     FileNotFoundError_,
     IndexNotFoundError,
     MetadataConflictError,
+    TornTailError,
     WALError,
 )
 from .wal import (
@@ -132,11 +133,13 @@ def _scan_python(blob: np.ndarray):
         [], [], [], [], [], [], []
     while pos < n:
         if pos + 8 > n:
-            raise WALError("truncated frame header")
+            raise TornTailError("truncated frame header")
         rlen = int.from_bytes(raw[pos:pos + 8], "little", signed=True)
         pos += 8
-        if rlen < 0 or rlen > n - pos:
-            raise WALError("truncated record")
+        if rlen < 0:
+            raise WALError(f"negative record length {rlen}")
+        if rlen > n - pos:
+            raise TornTailError("truncated record")
         rtype, crc, doff, dlen = _parse_record_span(raw, pos, rlen)
         types.append(rtype)
         crcs.append(crc)
@@ -280,9 +283,14 @@ def read_all_device(dirpath: str, index: int = 0
                 native.wal_scan(blob)
         except native.NativeError as e:
             # error-type parity with the host path: WAL corruption is
-            # a WALError regardless of which scanner found it
-            if "crc" in str(e):
+            # a WALError regardless of which scanner found it, and a
+            # stream that ends mid-record is the same typed
+            # TornTailError the host decoder raises (mapped by native
+            # return code, never message text)
+            if e.code == native.CRC_MISMATCH:
                 raise CRCMismatchError(str(e)) from e
+            if e.code == native.TRUNCATED:
+                raise TornTailError(str(e)) from e
             raise WALError(str(e)) from e
     else:
         try:

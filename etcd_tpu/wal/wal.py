@@ -31,6 +31,7 @@ from .errors import (
     FileNotFoundError_,
     IndexNotFoundError,
     MetadataConflictError,
+    TornTailError,
     WALError,
 )
 
@@ -166,13 +167,13 @@ class _Decoder:
         self.good = (self.fi, self.files[self.fi].tell())
         header = self._read(8)
         if len(header) < 8:
-            raise WALError("unexpected EOF in record length")
+            raise TornTailError("unexpected EOF in record length")
         (length,) = _LEN_STRUCT.unpack(header)
         if length < 0:
             raise WALError(f"negative record length {length}")
         data = self._read(length)
         if len(data) < length:
-            raise WALError("unexpected EOF in record body")
+            raise TornTailError("unexpected EOF in record body")
         rec = Record.unmarshal(data)
         # skip crc checking if the record type is crcType
         # (wal/decoder.go:41-43)
@@ -314,18 +315,26 @@ class WAL:
             nonlocal repaired
             try:
                 return self.decoder.decode()
-            except WALError as e:
-                # torn tail = unexpected EOF: the failing record is
-                # by construction the stream's last bytes (the chain
-                # is exhausted mid-record)
-                if repair and "unexpected EOF" in str(e):
+            except TornTailError as e:
+                # torn tail: the failing record is by construction the
+                # stream's last bytes (the chain is exhausted
+                # mid-record), so every byte from the record start to
+                # the end of the chain is part of the torn record —
+                # truncate the file it starts in AND empty any later
+                # files its bytes spilled into (unreachable from a
+                # single crash since writes never span segments, but
+                # repair exists for arbitrary crash states)
+                if repair:
                     fi, off = self.decoder.good
                     path = self.decoder.files[fi].name
                     os.truncate(path, off)
+                    for later in self.decoder.files[fi + 1:]:
+                        os.truncate(later.name, 0)
                     log.warning(
                         "wal: repaired torn tail: truncated %s at "
-                        "byte %d (%s)", os.path.basename(path), off,
-                        e)
+                        "byte %d, emptied %d later file(s) (%s)",
+                        os.path.basename(path), off,
+                        len(self.decoder.files) - fi - 1, e)
                     repaired = True
                     return None
                 raise

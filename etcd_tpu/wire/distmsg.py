@@ -168,6 +168,12 @@ class AppendResp:
     acked: np.ndarray   # [G] i32
     hint: np.ndarray    # [G] i32
     active: np.ndarray  # [G] bool
+    # LOCAL-ONLY (never marshalled): lanes whose entries the engine
+    # actually appended this frame.  ``ok`` also covers need_snap
+    # positive acks, which carry no entries — the follower's persist
+    # loop must write exactly what was appended, so it iterates this
+    # mask, not ``ok``.
+    appended: np.ndarray | None = None
 
     def marshal(self) -> bytes:
         g = self.term.shape[0]

@@ -236,6 +236,13 @@ inline uint64_t read_len_le(const uint8_t* buf) {
   return v;  // int64 little-endian; lengths are small positive
 }
 
+// The frame length is an int64; a set sign bit is framing corruption
+// (kErrProto -> plain WALError), NOT a torn tail (kErrTruncated ->
+// repairable TornTailError) — the Python scanner and the host decoder
+// make the same distinction, and strict-tpu replay policy depends on
+// all three lanes agreeing on which errors are healable.
+inline bool len_negative(uint64_t rlen) { return (rlen >> 63) != 0; }
+
 }  // namespace
 
 extern "C" {
@@ -360,6 +367,7 @@ int64_t etcd_wal_count(const uint8_t* buf, uint64_t n) {
     if (pos + 8 > n) return kErrTruncated;
     uint64_t rlen = read_len_le(buf + pos);
     pos += 8;
+    if (len_negative(rlen)) return kErrProto;
     if (rlen > n - pos) return kErrTruncated;
     pos += rlen;
     count++;
@@ -381,6 +389,7 @@ int64_t etcd_wal_scan(const uint8_t* buf, uint64_t n, int64_t* types,
     if (pos + 8 > n) return kErrTruncated;
     uint64_t rlen = read_len_le(buf + pos);
     pos += 8;
+    if (len_negative(rlen)) return kErrProto;
     if (rlen > n - pos) return kErrTruncated;
     if (static_cast<uint64_t>(count) >= cap) return kErrCapacity;
     int64_t rc = parse_record(buf, pos, pos + rlen, &types[count],
@@ -416,6 +425,7 @@ int64_t etcd_replay_verify(const uint8_t* buf, uint64_t n, uint32_t seed,
     if (pos + 8 > n) return kErrTruncated;
     uint64_t rlen = read_len_le(buf + pos);
     pos += 8;
+    if (len_negative(rlen)) return kErrProto;
     if (rlen > n - pos) return kErrTruncated;
     int64_t type;
     uint32_t crc;

@@ -1,0 +1,41 @@
+"""Probe the axon device relay and append the outcome to
+bench_artifacts/relay_preflights.jsonl via bench.py's own recorder
+(single copy of the artifact path + record format).
+
+A dead-relay round must show a probe HISTORY in the bench artifact
+(VERDICT r3 #1), not a single failed connect at round end; this script
+is run periodically during a build round and bench.py folds the
+accumulated file into its emitted JSON (``relay_preflights``).
+
+Exit code: 0 when the relay accepts a TCP connect, 1 otherwise.
+"""
+
+import os
+import socket
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import bench  # noqa: E402  (repo-root module; no jax at import time)
+
+
+def main() -> int:
+    host = os.environ.get("PALLAS_AXON_POOL_IPS",
+                          "127.0.0.1").split(",")[0]
+    port = int(os.environ.get("BENCH_RELAY_PORT", 8083))
+    s = socket.socket()
+    s.settimeout(2)
+    try:
+        s.connect((host, port))
+        outcome, rc = "up", 0
+    except OSError as e:
+        outcome, rc = f"down: {e}"[:120], 1
+    finally:
+        s.close()
+    bench.record_preflight(outcome)
+    print(outcome)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

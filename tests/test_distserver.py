@@ -603,3 +603,56 @@ def test_append_with_term_change_keeps_wal_contiguous(tmp_path):
                     g=g, cap=64, tick_interval=0.05)
     assert (s2.mr.terms() == 5).all()
     s2.wal.close()
+
+
+def test_need_snap_lanes_never_persist_phantom_entries(tmp_path):
+    """Advisor r3 regression: a need_snap lane acks ok=True (positive
+    commit ack, raft.go:418-424 analog) but the engine appends NOTHING
+    for it — the persist loop must iterate resp.appended, not resp.ok.
+    A (buggy or future) leader shipping entries alongside need_snap
+    must not get those entries into this host's WAL: the engine never
+    accepted them, and persisting them would diverge WAL from engine
+    state on the next restart."""
+    from etcd_tpu.wire.distmsg import AppendBatch, unmarshal_any
+
+    g = 4
+    urls = [f"http://127.0.0.1:{p}" for p in free_ports_n(2)]
+    s = DistServer(str(tmp_path / "d0"), slot=0, peer_urls=urls,
+                   g=g, cap=64, tick_interval=0.05)
+    payload = Request(method="PUT", id=9, path="/x", val="v").marshal()
+    term = np.full(g, 5, np.int32)
+    need = np.array([False, True, False, True])
+    frame = AppendBatch(
+        sender=1, term=term,
+        prev_idx=np.zeros(g, np.int32),
+        prev_term=np.zeros(g, np.int32),
+        n_ents=np.ones(g, np.int32),  # entries on EVERY lane,
+        commit=np.zeros(g, np.int32),  # including need_snap ones
+        active=np.ones(g, bool),
+        need_snap=need,
+        ent_terms=np.full((g, 1), 5, np.int32),
+        payloads=[[payload] for _ in range(g)])
+    resp = unmarshal_any(s.handle_frame(frame.marshal()))
+    # wire-level ok covers the need lanes (positive ack at commit) ...
+    assert resp.ok.all()
+    s.wal.close()
+
+    # ... but the WAL holds entry records ONLY for the lanes the
+    # engine actually appended
+    from etcd_tpu.wal import WAL
+    from etcd_tpu.wire import GroupEntry
+
+    w = WAL.open_at_index(str(tmp_path / "d0" / "wal"), 0)
+    _, _, ents = w.read_all()
+    w.close()
+    groups_with_entries = {
+        ge.group for ge in (GroupEntry.unmarshal(e.data)
+                            for e in ents if e.data)
+        if ge.kind == 0 and ge.payload}
+    assert groups_with_entries == {0, 2}
+
+    # and the directory restarts cleanly
+    s2 = DistServer(str(tmp_path / "d0"), slot=0, peer_urls=urls,
+                    g=g, cap=64, tick_interval=0.05)
+    assert (s2.mr.terms() == 5).all()
+    s2.wal.close()

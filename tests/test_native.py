@@ -125,13 +125,17 @@ def test_scan_real_wal_file(tmp_path):
 
 
 def test_negative_length_prefix_errors():
-    """A corrupt frame header must error, not loop forever."""
+    """A corrupt frame header must error, not loop forever — and a
+    NEGATIVE length is framing corruption (proto error, code -2), not
+    a torn tail (code -1): the typed-exception mapping in
+    replay_device.py heals torn tails but must never heal this."""
     import struct
     bad = np.frombuffer(struct.pack("<q", -8), dtype=np.uint8).copy()
-    with pytest.raises(native.NativeError, match="truncated"):
-        native.replay_verify(bad, seed=0)
-    with pytest.raises(native.NativeError, match="truncated"):
-        native.wal_scan(bad)
+    for fn in (lambda: native.replay_verify(bad, seed=0),
+               lambda: native.wal_scan(bad)):
+        with pytest.raises(native.NativeError, match="proto") as ei:
+            fn()
+        assert ei.value.code == native.PROTO_ERR
 
 
 def test_wal_count_exact():

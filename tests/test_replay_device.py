@@ -129,13 +129,18 @@ def test_real_server_wal_replays(tmp_path):
 
 
 def test_python_scan_negative_length(monkeypatch):
-    """Python fallback must reject a negative frame length."""
+    """Python fallback must reject a negative frame length as plain
+    corruption (WALError), NOT as a repairable torn tail."""
     import struct
     from etcd_tpu.wal.replay_device import _scan_python
-    from etcd_tpu.wal.errors import WALError
+    from etcd_tpu.wal.errors import TornTailError, WALError
     bad = np.frombuffer(struct.pack("<q", -8), dtype=np.uint8).copy()
-    with pytest.raises(WALError, match="truncated"):
+    with pytest.raises(WALError, match="negative record length"):
         _scan_python(bad)
+    try:
+        _scan_python(bad)
+    except WALError as e:
+        assert not isinstance(e, TornTailError)
 
 
 def test_open_replay_device_append_continuation(tmp_path):
@@ -268,16 +273,19 @@ def test_python_scan_wrong_wiretype_aborts():
 
 def test_native_error_maps_to_walerror(tmp_path, monkeypatch):
     """--storage-backend=tpu corruption surfaces as WALError, not
-    NativeError (error-type parity with the host path)."""
-    from etcd_tpu.wal.errors import WALError
+    NativeError (error-type parity with the host path); the mapping
+    keys on the native return CODE, never on message text."""
+    from etcd_tpu.wal.errors import TornTailError, WALError
 
     d = tmp_path / "wal"
     _write_wal(d, n_entries=3, cuts=())
     monkeypatch.setattr(native, "available", lambda: True)
-    for msg, exc in (("truncated stream", WALError),
-                     ("crc mismatch", CRCMismatchError)):
-        def raiser(blob, _msg=msg):
-            raise native.NativeError(_msg)
+    for msg, code, exc in (
+            ("truncated stream", native.TRUNCATED, TornTailError),
+            ("crc mismatch", native.CRC_MISMATCH, CRCMismatchError),
+            ("proto parse error", native.PROTO_ERR, WALError)):
+        def raiser(blob, _msg=msg, _code=code):
+            raise native.NativeError(_msg, _code)
         monkeypatch.setattr(native, "wal_scan", raiser)
         with pytest.raises(exc, match=msg.split()[0]):
             read_all_device(str(d), 0)

@@ -270,3 +270,53 @@ def test_torn_tail_repair(tmp_path):
 
     with pytest.raises((WALError, ProtoError)):
         WAL.open_at_index(d, 0).read_all(repair=True)
+
+
+def test_torn_tail_repair_spans_segments(tmp_path):
+    """A torn record whose claimed length spills past the file it
+    starts in consumes every later file's bytes; repair must truncate
+    the starting file AND empty the later files, or the 'repaired'
+    directory misparses on the next open (advisor r3 finding).
+    Unreachable from a single crash (writes never span segments) but
+    repair exists for arbitrary crash states."""
+    import struct
+
+    d = str(tmp_path / "wal")
+    w = WAL.create(d, b"meta")
+    w.save(HardState(term=1, vote=0, commit=2),
+           [Entry(term=1, index=i, data=bytes([i]) * 50)
+            for i in range(3)])
+    w.cut()
+    w.save_entry(Entry(term=1, index=3, data=b"second-segment"))
+    w.sync()
+    w.close()
+    names = sorted(os.listdir(d))
+    assert len(names) == 2
+    f0, f1 = (os.path.join(d, n) for n in names)
+    f0_size, f1_size = os.path.getsize(f0), os.path.getsize(f1)
+
+    # splice a torn record at the end of file 0 whose length claim
+    # swallows all of file 1: header says 4096 bytes, only 10 follow
+    with open(f0, "ab") as fh:
+        fh.write(struct.pack("<q", 4096) + b"\xAA" * 10)
+
+    from etcd_tpu.wal.errors import TornTailError
+    with pytest.raises(TornTailError):
+        WAL.open_at_index(d, 0).read_all()
+
+    w2 = WAL.open_at_index(d, 0)
+    md, st, got = w2.read_all(repair=True)
+    assert md == b"meta"
+    # entry 3 lived in file 1, whose bytes became part of the torn
+    # record — everything from the tear forward is discarded
+    assert [e.index for e in got] == [0, 1, 2]
+    assert os.path.getsize(f0) == f0_size  # torn splice removed
+    assert os.path.getsize(f1) == 0        # later file emptied
+    # the repaired WAL appends (into the emptied tail segment) and
+    # replays cleanly on the next open
+    w2.save(HardState(term=1, vote=0, commit=3),
+            [Entry(term=1, index=3, data=b"replacement")])
+    w2.close()
+    _, _, again = WAL.open_at_index(d, 0).read_all()
+    assert [e.index for e in again] == [0, 1, 2, 3]
+    assert again[-1].data == b"replacement"
