@@ -62,7 +62,7 @@ C3_SNAP_MB = int(os.environ.get("BENCH_C3_SNAP_MB", 256))
 C4_GROUPS = int(os.environ.get("BENCH_C4_GROUPS", 100_000))
 C4_ROUNDS = int(os.environ.get("BENCH_C4_ROUNDS", 30))
 C5_GROUPS = int(os.environ.get("BENCH_C5_GROUPS", 100_000))
-DIST_PROPOSALS = int(os.environ.get("BENCH_DIST_PROPOSALS", 2000))
+DIST_PROPOSALS = int(os.environ.get("BENCH_DIST_PROPOSALS", 16000))
 RESTART_ENTRIES = int(os.environ.get("BENCH_RESTART_ENTRIES",
                                      1_000_000))
 # Accelerator init can be slow behind a device tunnel; probe generously
@@ -663,12 +663,12 @@ def run_extra_configs(extra: dict, backend: str,
     if DIST_PROPOSALS:
         try:
             r = _run_json_subbench("dist_bench.py",
-                                   [str(DIST_PROPOSALS), "8"],
+                                   [str(DIST_PROPOSALS), "8", "512"],
                                    key="proposals_per_sec",
                                    timeout=600)
             if r is not None:
-                log(f"dist: {r['acked']} acked over 3 hosts at "
-                    f"{r['proposals_per_sec']}/s")
+                log(f"dist: {r['acked']} acked over 3 real "
+                    f"processes at {r['proposals_per_sec']}/s")
                 extra["dist_cluster"] = r
                 checkpoint("dist_cluster", r)
         except Exception as e:

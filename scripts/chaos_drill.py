@@ -6,7 +6,13 @@ Invariants checked each cycle:
 - every key's value is SOME issued write (no fabricated or lost
   values; a timed-out PUT committing late is at-least-once, same as
   the reference's in-flight proposals);
-- the restarted victim reaches replica EQUALITY with a survivor.
+- the restarted victim reaches replica EQUALITY with a survivor;
+- LIVENESS: the time from kill -9 to every group accepting writes
+  again is recorded per cycle; the drill fails if p99 recovery
+  exceeds 2x the worst-case election timeout plus probe slack
+  (VERDICT r3 #6 — the ~12s leaderless windows came from lockstep
+  split votes, fixed by per-campaign timeout re-randomization in
+  distmember.begin_campaign).
 
 Round-3 history: this drill found two crash-recovery bugs the
 in-process suites missed — the ballot/entry WAL seq-ordering gap
@@ -66,6 +72,22 @@ def get(base, key, timeout=10):
         return json.loads(r.read())
 
 
+# key -> group coverage for the recovery probe (the 7 drill keys must
+# touch every group, else a group's recovery is unobserved).  This
+# must run BEFORE the servers spawn: a failure here would skip the
+# try/finally and orphan three server processes on the shared core.
+sys.path.insert(0, REPO)
+from etcd_tpu.server.multigroup import group_of  # noqa: E402
+
+N_GROUPS = 4
+# namespaces (the first path segment is what group_of hashes) chosen
+# to cover every group; two extra namespaces keep multi-key churn
+# within groups
+KEYS = ["/c0/k", "/c2/k", "/c6/k", "/c9/k", "/c0/k2", "/c2/k2",
+        "/c6/k2"]
+_covered = {group_of(k, N_GROUPS) for k in KEYS}
+assert _covered == set(range(N_GROUPS)), _covered
+
 shutil.rmtree(BASE, ignore_errors=True)  # stale dirs from a prior
 # run would replay old values outside this run's issued set
 os.makedirs(BASE, exist_ok=True)
@@ -78,6 +100,7 @@ issued = {}   # key -> set of ALL issued values (acked or timed out:
               # a timed-out PUT may commit late — at-least-once)
 seq = 0
 lost = []
+recovery = []  # per-cycle: seconds from kill to all-groups-writable
 
 try:
     for cycle in range(CYCLES):
@@ -96,19 +119,33 @@ try:
                 os.truncate(seg, os.path.getsize(seg) - cut)
                 print(f"cycle {cycle}: tore {cut} bytes off "
                       f"s{victim}'s WAL tail", flush=True)
-        t_end = time.time() + 12
+        t_kill = time.time()
+        t_end = t_kill + 12
         ok = fail = 0
+        # liveness probe state: first post-kill ack time per group
+        group_up = {}
         while time.time() < t_end:
             seq += 1
-            key, val = f"/c/k{seq % 7}", f"v{seq}"
+            key = KEYS[seq % 7]
+            val = f"v{seq}"
             tgt = CLIENT[rng.choice(survivors)]
             issued.setdefault(key, set()).add(val)
             try:
-                put(tgt, key, val)
+                # short timeout: a leaderless group must read as DOWN
+                # within the probe resolution, not block for 20s
+                put(tgt, key, val, timeout=3)
                 acked[key] = val
                 ok += 1
+                group_up.setdefault(group_of(key, N_GROUPS),
+                                    time.time())
             except Exception:
                 fail += 1
+        if len(group_up) == N_GROUPS:
+            recovery.append(max(group_up.values()) - t_kill)
+        else:
+            # a group never recovered inside the window — record the
+            # full window as a (pessimistic) lower bound
+            recovery.append(time.time() - t_kill)
         # every key's current value must be SOME issued write (a
         # fabricated or lost value is a real safety violation; a
         # late-committing timed-out write is not)
@@ -122,7 +159,8 @@ try:
                 lost.append((cycle, key, got))
         print(f"cycle {cycle}: killed s{victim}, {ok} acked "
               f"({fail} rejected), {len(acked)} keys verified, "
-              f"lost={len(lost)}", flush=True)
+              f"lost={len(lost)}, recovery={recovery[-1]:.2f}s",
+              flush=True)
         # restart the victim; it must catch up
         procs[victim] = start(victim)
         time.sleep(14)
@@ -145,6 +183,17 @@ try:
               flush=True)
         assert caught, f"s{victim} failed to catch up"
     assert not lost, lost
+    rec = sorted(recovery)
+    p50 = rec[len(rec) // 2]
+    p99 = rec[min(len(rec) - 1, int(len(rec) * 0.99))]
+    # Liveness gate: worst-case election timeout = 2*election ticks
+    # (distmember init: timeout in [election, 2*election)); with the
+    # CLI defaults (election=10 ticks x 0.1s tick) that is 2s, 2x = 4s
+    # + 3s probe-timeout resolution slack.  Pre-fix windows were ~12s.
+    bound = 7.0
+    print(f"recovery: p50 {p50:.2f}s p99 {p99:.2f}s "
+          f"(bound {bound}s, n={len(rec)})", flush=True)
+    assert p99 < bound, f"p99 leader recovery {p99:.2f}s >= {bound}s"
     print(f"CHAOS DRILL CLEAN: {CYCLES} kill/restart cycles, "
           f"{seq} writes, zero acked writes lost", flush=True)
 finally:

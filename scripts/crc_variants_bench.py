@@ -1,0 +1,138 @@
+"""Race the raw-CRC contraction variants on the current backend.
+
+For each variant (production XLA path, Pallas kernel, and the
+ops/crc_variants.py candidates) this measures the device-sustained
+rate with the same methodology as bench.py's primary metric: the
+batch stays device-resident, the body XORs the loop index in so XLA
+cannot hoist it, and one scalar fetch at the end is the only sync.
+A correctness gate (iteration-0 chain verify against stored CRCs)
+must pass or the variant's number is reported as failed.
+
+Prints one JSON line per variant plus a `best` summary line.
+
+  python scripts/crc_variants_bench.py [N_ROWS] [WIDTH] [ITERS]
+
+(Run under the tunnel for real-chip numbers; runs anywhere for a
+relative CPU sanity check, labeled by backend.)
+"""
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 18
+    width = int(sys.argv[2]) if len(sys.argv) > 2 else 384
+    iters = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+
+    import jax
+    import jax.numpy as jnp
+
+    from etcd_tpu.crc import crc32c
+    from etcd_tpu.ops.crc_device import (
+        _raw_crc_jit,
+        chain_links_injected,
+        contribution_matrix,
+        inject_seeds,
+    )
+    from etcd_tpu.ops.crc_variants import VARIANTS, plane_matrices
+
+    backend = jax.default_backend()
+
+    # synthetic right-aligned chained records (seed-injected, so every
+    # variant's gate is the full rolling-chain verify)
+    rng = np.random.default_rng(3)
+    lens = rng.integers(width // 2, width - 4, size=n)
+    rows = np.zeros((n, width), np.uint8)
+    stored = np.empty(n, np.uint32)
+    prev_ = np.empty(n, np.uint32)
+    chain = 0
+    # vectorized-ish generation: fill then fix chains in one pass
+    fill = rng.integers(0, 256, size=(n, width), dtype=np.uint8)
+    for i in range(n):
+        li = int(lens[i])
+        rows[i, width - li:] = fill[i, :li]
+        prev_[i] = chain
+        chain = crc32c.update(chain, rows[i, width - li:].tobytes())
+        stored[i] = chain
+    inject_seeds(rows, lens, prev_)
+
+    drows = jax.device_put(rows)
+    dstored = jax.device_put(stored)
+
+    c = jnp.asarray(contribution_matrix(width))
+    ck = jnp.asarray(plane_matrices(width))
+
+    def make_fn(name):
+        if name == "xla":
+            return lambda b: _raw_crc_jit(b, c, use_pallas=False)
+        if name == "pallas":
+            return lambda b: _raw_crc_jit(b, c, use_pallas=True)
+        from etcd_tpu.ops import crc_variants
+
+        jit_map = {"planes": lambda b: crc_variants._planes_jit(b, ck),
+                   "transposed":
+                   lambda b: crc_variants._transposed_jit(b, c),
+                   "planes_t":
+                   lambda b: crc_variants._planes_t_jit(b, ck)}
+        return jit_map[name]
+
+    names = ["xla"] + sorted(VARIANTS)
+    if backend == "tpu":
+        names.insert(1, "pallas")
+
+    results = {}
+    for name in names:
+        fn = make_fn(name)
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def loop(rows_, stored_, k, _fn=fn):
+            def body(i, acc):
+                buf = rows_ ^ i.astype(jnp.uint8)
+                ok = chain_links_injected(_fn(buf), stored_)
+                return acc + jnp.where(
+                    i == 0, jnp.sum(ok, dtype=jnp.int32), 0)
+
+            return jax.lax.fori_loop(0, k, body, jnp.int32(0))
+
+        try:
+            t0 = time.perf_counter()
+            n_ok = int(loop(drows, dstored, iters))  # compile+gate
+            compile_s = time.perf_counter() - t0
+            if n_ok != n:
+                results[name] = {"error": f"gate {n_ok}/{n}"}
+                print(json.dumps({"variant": name,
+                                  **results[name]}), flush=True)
+                continue
+            t0 = time.perf_counter()
+            int(loop(drows, dstored, iters))
+            dt = time.perf_counter() - t0
+            eps = n * iters / dt
+            gbps = n * width * iters / dt / 1e9
+            results[name] = {"entries_per_sec": round(eps, 1),
+                             "gbps": round(gbps, 3),
+                             "compile_s": round(compile_s, 2)}
+            print(json.dumps({"variant": name, "backend": backend,
+                              **results[name]}), flush=True)
+        except Exception as e:  # per-variant isolation
+            results[name] = {"error": repr(e)[:200]}
+            print(json.dumps({"variant": name,
+                              **results[name]}), flush=True)
+
+    ok = {k: v for k, v in results.items() if "entries_per_sec" in v}
+    if ok:
+        best = max(ok, key=lambda k: ok[k]["entries_per_sec"])
+        print(json.dumps({
+            "best": best, "backend": backend, "n": n, "width": width,
+            "iters": iters, **ok[best]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

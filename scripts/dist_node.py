@@ -39,6 +39,7 @@ def main() -> None:
                     help="comma-separated slot-indexed base URLs")
     ap.add_argument("--groups", type=int, default=8)
     ap.add_argument("--cap", type=int, default=64)
+    ap.add_argument("--max-batch-ents", type=int, default=32)
     ap.add_argument("--bootstrap", action="store_true",
                     help="campaign for every group before READY")
     args = ap.parse_args()
@@ -46,9 +47,55 @@ def main() -> None:
     srv = DistServer(args.data_dir, slot=args.slot,
                      peer_urls=args.peers.split(","),
                      g=args.groups, cap=args.cap,
+                     max_batch_ents=args.max_batch_ents,
                      tick_interval=0.05, post_timeout=2.0,
                      election=60)
     srv.start()
+
+    # SIGUSR1 dumps the tracer span table to stdout (profiling a real
+    # cluster process from outside without stopping it)
+    import signal as _signal
+
+    prof = None
+    if os.environ.get("ETCD_PROFILE_FRAMES"):
+        # function-level attribution for the peer-frame hot path:
+        # wrap handle_frame in a cProfile that accumulates across
+        # calls.  cProfile is strictly single-tool-at-a-time, so a
+        # lock serializes concurrent handler threads (this is a
+        # diagnostic mode; the serialization is part of the price)
+        import cProfile
+        import threading as _threading
+
+        prof = cProfile.Profile()
+        _prof_lock = _threading.Lock()
+        inner = srv.handle_frame
+
+        def profiled(data):
+            with _prof_lock:
+                prof.enable()
+                try:
+                    return inner(data)
+                finally:
+                    prof.disable()
+
+        srv.handle_frame = profiled
+
+    def _dump(signum, frame):
+        from etcd_tpu.utils.trace import tracer
+
+        print("SPANS " + tracer.snapshot_json().decode(), flush=True)
+        if prof is not None:
+            import io
+            import pstats
+
+            s = io.StringIO()
+            pstats.Stats(prof, stream=s).sort_stats(
+                "cumulative").print_stats(25)
+            print("PROFILE-BEGIN", flush=True)
+            print(s.getvalue(), flush=True)
+            print("PROFILE-END", flush=True)
+
+    _signal.signal(_signal.SIGUSR1, _dump)
     if args.bootstrap:
         deadline = time.time() + 60.0
         while time.time() < deadline:
