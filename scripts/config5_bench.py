@@ -68,7 +68,9 @@ def main() -> None:
     # fused proposal trains running SPMD across the mesh devices.
     from etcd_tpu.raft.multiraft import MultiRaft
 
-    mr = MultiRaft(g=g, m=5, cap=64)
+    # same log-window/append-window class as the step above (cap 32);
+    # e=4 covers the 1-proposal/round serving load with headroom
+    mr = MultiRaft(g=g, m=5, cap=32, max_batch_ents=4)
     mr.shard(mesh)
     mr.campaign(0)
     one = np.ones(g, np.int32)
