@@ -765,30 +765,23 @@ def measure_sustained(jax, rows, stored, iters):
     out of the loop; only iteration 0 (the unperturbed rows) feeds the
     correctness gate.  One scalar fetch at the end is the only sync.
 
-    Returns (entries_per_sec, ok_count_of_unperturbed_pass).
+    Returns (entries_per_sec, ok_count_of_unperturbed_pass,
+    variant_name).
     """
     import functools
 
     import jax.numpy as jnp
 
-    from etcd_tpu.ops.crc_device import (
-        _default_use_pallas,
-        _raw_crc_jit,
-        contribution_matrix,
-    )
-
-    c = jnp.asarray(contribution_matrix(rows.shape[1]))
+    raw_fn, variant = _make_raw_fn()
+    log(f"sustained kernel variant: {variant}")
     drows = jax.device_put(rows)
     dstored = jax.device_put(np.asarray(stored, np.uint32))
-    use_pallas = os.environ.get("BENCH_USE_PALLAS")
-    use_pallas = (_default_use_pallas() if use_pallas is None
-                  else use_pallas == "1")
 
-    @functools.partial(jax.jit, static_argnames=("k", "up"))
-    def loop(rows, stored, c, k, up):
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def loop(rows, stored, k):
         def body(i, acc):
             buf = rows ^ i.astype(jnp.uint8)
-            raw = _raw_crc_jit(buf, c, use_pallas=up)
+            raw = raw_fn(buf)
             ok = (raw ^ jnp.uint32(0xFFFFFFFF)) == stored
             n_ok = jnp.sum(ok, dtype=jnp.int32)
             return acc + jnp.where(i == 0, n_ok, 0)
@@ -797,11 +790,41 @@ def measure_sustained(jax, rows, stored, iters):
 
     # warm with the SAME static k — a different k is a different
     # executable, and its compile must not land in the timed region
-    int(loop(drows, dstored, c, iters, use_pallas))
+    int(loop(drows, dstored, iters))
     t0 = time.perf_counter()
-    n_ok = int(loop(drows, dstored, c, iters, use_pallas))
+    n_ok = int(loop(drows, dstored, iters))
     dt = time.perf_counter() - t0
-    return rows.shape[0] * iters / dt, n_ok
+    return rows.shape[0] * iters / dt, n_ok, variant
+
+
+def _make_raw_fn():
+    """The raw-CRC contraction the sustained loop runs, selected by
+    BENCH_CRC_VARIANT: xla | pallas | planes | transposed | planes_t
+    (ops/crc_variants.py candidates — race them on hardware with
+    scripts/crc_variants_bench.py and pick here).  Default: the
+    production auto choice (pallas on tpu, xla elsewhere).  The
+    returned callable is traced inside the sustained loop's jit, so
+    the wrappers' matrix constructions fold into compile-time
+    constants."""
+    from etcd_tpu.ops.crc_device import (
+        _default_use_pallas,
+        raw_crc_batch,
+    )
+
+    v = os.environ.get("BENCH_CRC_VARIANT", "")
+    if not v:
+        # legacy knob kept working
+        up = os.environ.get("BENCH_USE_PALLAS")
+        up = _default_use_pallas() if up is None else up == "1"
+        v = "pallas" if up else "xla"
+    if v in ("xla", "pallas"):
+        return (lambda b: raw_crc_batch(
+            b, use_pallas=(v == "pallas"))), v
+    from etcd_tpu.ops import crc_variants
+
+    if v not in crc_variants.VARIANTS:
+        raise ValueError(f"unknown BENCH_CRC_VARIANT {v!r}")
+    return crc_variants.VARIANTS[v], v
 
 
 def probe_env_ceiling(jax) -> float | None:
@@ -1032,7 +1055,8 @@ def main():
                 checkpoint("sustained",
                            {"outcome": f"error: {r!r}"[:200]})
             else:
-                sus_eps, n_ok = r
+                sus_eps, n_ok, crc_variant = r
+                extra["crc_variant"] = crc_variant
                 if n_ok != total_entries:
                     # a failed gate must not promote a number — fall
                     # back to whatever e2e measures below

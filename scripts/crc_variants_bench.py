@@ -33,6 +33,14 @@ def main() -> None:
     iters = int(sys.argv[3]) if len(sys.argv) > 3 else 8
 
     import jax
+
+    # env-only platform selection loses to the axon plugin's
+    # import-time override (tests/conftest.py pattern); honor an
+    # explicit JAX_PLATFORMS at the config level so CPU runs never
+    # hang on a dead relay
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms",
+                          os.environ["JAX_PLATFORMS"])
     import jax.numpy as jnp
 
     from etcd_tpu.crc import crc32c
