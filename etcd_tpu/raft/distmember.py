@@ -142,6 +142,7 @@ class DistMember:
                                                  for _ in range(g)]
         self.errors = {"overflow": np.zeros(g, bool),
                        "conflict": np.zeros(g, bool)}
+        self._placer = None  # set by shard(): parallel.mesh placer
 
     # -- intra-host scale-out ---------------------------------------------
 
@@ -153,11 +154,31 @@ class DistMember:
         cross-device collectives, while the frame exchange above is
         unchanged.  Callers re-invoke after wholesale state
         replacement (restart seeding)."""
-        from ..parallel.mesh import check_group_divisible, shard_leading
+        from ..parallel.mesh import (
+            check_group_divisible,
+            leading_placer,
+            shard_leading,
+        )
 
         check_group_divisible(mesh, self.g)
         self.state = type(self.state)(
             *(shard_leading(mesh, x) for x in self.state))
+        # per-frame [G]/[G, E] host inputs must be PLACED with the
+        # same g-sharding before each dispatch (leading_placer's
+        # docstring has the why)
+        self._placer = leading_placer(mesh)
+
+    def _put(self, arr, dtype=None):
+        """Host array → device, g-sharded when the state is."""
+        if self._placer is not None:
+            return self._placer(arr, dtype)
+        return jnp.asarray(np.asarray(arr, dtype))
+
+    def _full(self, value, dtype=jnp.int32):
+        """[G] constant vector, placed like every other [G] input (an
+        eagerly-created jnp.full lands on the default device and
+        reintroduces the per-dispatch reshard _put exists to avoid)."""
+        return self._put(np.full(self.g, value), dtype)
 
     # -- views ------------------------------------------------------------
 
@@ -189,7 +210,7 @@ class DistMember:
         retained window)."""
         st = self.state
         return np.asarray(term_at(st.log_term, st.offset, st.last,
-                                  jnp.asarray(idx, jnp.int32)))
+                                  self._put(idx, np.int32)))
 
     def committed_payload(self, group: int, index: int):
         return self.payloads[group].get(index)
@@ -205,8 +226,8 @@ class DistMember:
         base = np.asarray(st.last)
         lead = self.is_leader()
         st, err = leader_append(
-            st, jnp.asarray(np.asarray(n_new, np.int32)),
-            jnp.full((self.g,), self.slot, jnp.int32))
+            st, self._put(n_new, np.int32),
+            self._full(self.slot))
         self.state = st
         overflow = np.asarray(err)
         self.errors["overflow"] = overflow
@@ -240,7 +261,7 @@ class DistMember:
         # one device gather for prev terms + entry terms
         terms2 = np.asarray(term_at(
             st.log_term, st.offset, st.last,
-            jnp.asarray(np.concatenate(
+            self._put(np.concatenate(
                 [prev_idx[:, None], idx], axis=1))))
         payloads = []
         for gi in range(self.g):
@@ -261,9 +282,9 @@ class DistMember:
         vector after quorum advance."""
         before = np.asarray(self.state.commit)
         self.state = _absorb_resp(
-            self.state, r.sender, jnp.asarray(r.term),
-            jnp.asarray(r.ok), jnp.asarray(r.acked),
-            jnp.asarray(r.hint), jnp.asarray(r.active))
+            self.state, r.sender, self._put(r.term),
+            self._put(r.ok), self._put(r.acked),
+            self._put(r.hint), self._put(r.active))
         return np.asarray(self.state.commit)
 
     # -- follower path ----------------------------------------------------
@@ -274,21 +295,20 @@ class DistMember:
         payloads, reply with match/hint arrays.  The CALLER persists
         the accepted entries BEFORE shipping the response."""
         st = self.state
-        active = jnp.asarray(b.active)
-        term = jnp.asarray(b.term)
-        st = _adopt_term(st, term, jnp.full((self.g,), b.sender,
-                                            jnp.int32), active)
+        active = self._put(b.active)
+        term = self._put(b.term)
+        st = _adopt_term(st, term, self._full(b.sender), active)
         # equal-term appends also establish leadership + reset timer
         cur = active & (term == st.term)
         st = st._replace(
             role=jnp.where(cur, FOLLOWER, st.role),
             lead=jnp.where(cur, b.sender, st.lead),
             elapsed=jnp.where(cur, 0, st.elapsed))
-        do = cur & ~jnp.asarray(b.need_snap)
+        do = cur & ~self._put(b.need_snap)
         st, ok, e_conf, e_over = maybe_append(
-            st, jnp.asarray(b.prev_idx), jnp.asarray(b.prev_term),
-            jnp.asarray(b.ent_terms), jnp.asarray(b.n_ents),
-            jnp.asarray(b.commit), active=do)
+            st, self._put(b.prev_idx), self._put(b.prev_term),
+            self._put(b.ent_terms), self._put(b.n_ents),
+            self._put(b.commit), active=do)
         self.state = st
         self.errors["conflict"] = np.asarray(e_conf)
         self.errors["overflow"] = (self.errors["overflow"]
@@ -326,9 +346,9 @@ class DistMember:
         """Collapse lanes to a pulled snapshot's frontier
         (raft.go:535-554 batched); returns installed lanes."""
         st, installed = restore_snapshot(
-            self.state, jnp.asarray(frontier, jnp.int32),
-            jnp.asarray(terms, jnp.int32),
-            members=None if members is None else jnp.asarray(members))
+            self.state, self._put(frontier, np.int32),
+            self._put(terms, np.int32),
+            members=None if members is None else self._put(members))
         self.state = st
         inst = np.asarray(installed)
         for gi in np.nonzero(inst)[0]:
@@ -355,14 +375,13 @@ class DistMember:
         (the chaos drill's ~12s leaderless windows, VERDICT r3 #6).
         Re-drawing makes consecutive splits decorrelate at every
         retry."""
-        mask = np.asarray(mask, bool)
+        mask_d = self._put(np.asarray(mask, bool))
         st, mj, lterm = _begin_campaign(
-            self.state, jnp.asarray(mask), slot=self.slot)
+            self.state, mask_d, slot=self.slot)
         fresh = self._rng.integers(self.election, 2 * self.election,
                                    size=self.g)
         st = st._replace(timeout=jnp.where(
-            jnp.asarray(mask),
-            jnp.asarray(fresh, jnp.int32), st.timeout))
+            mask_d, self._put(fresh, np.int32), st.timeout))
         self.state = st
         return VoteReq(sender=self.slot, term=np.asarray(st.term),
                        last=np.asarray(st.last),
@@ -374,13 +393,13 @@ class DistMember:
         terms, grant where log-up-to-date and not already voted.
         Caller persists the ballot before shipping the response."""
         st = self.state
-        active = jnp.asarray(v.active)
-        st = _adopt_term(st, jnp.asarray(v.term),
-                         jnp.full((self.g,), -1, jnp.int32), active)
+        active = self._put(v.active)
+        term = self._put(v.term)
+        st = _adopt_term(st, term,
+                         self._full(-1), active)
         st, granted = grant_vote(
-            st, jnp.asarray(v.last), jnp.asarray(v.lterm),
-            jnp.asarray(v.term),
-            jnp.full((self.g,), v.sender, jnp.int32), active=active)
+            st, self._put(v.last), self._put(v.lterm), term,
+            self._full(v.sender), active=active)
         st = st._replace(elapsed=jnp.where(granted, 0, st.elapsed))
         self.state = st
         return VoteResp(sender=self.slot, term=np.asarray(st.term),
@@ -395,14 +414,14 @@ class DistMember:
         votes = np.asarray(mask, np.int32).copy()  # own vote
         st = self.state
         for r in resps:
-            st = _adopt_term(st, jnp.asarray(r.term),
-                             jnp.full((self.g,), -1, jnp.int32),
-                             jnp.asarray(r.active))
+            st = _adopt_term(st, self._put(r.term),
+                             self._full(-1),
+                             self._put(r.active))
             votes += (r.granted & r.active).astype(np.int32)
         quorum = np.asarray(st.nmembers) // 2 + 1
         still_cand = np.asarray(st.role) == CANDIDATE
         won = np.asarray(mask, bool) & still_cand & (votes >= quorum)
-        self.state = _become_leader(st, jnp.asarray(won),
+        self.state = _become_leader(st, self._put(won),
                                     slot=self.slot)
         if won.any():
             # Raft safety: uncommitted tail payloads beyond our last
@@ -427,7 +446,7 @@ class DistMember:
 
     def mark_applied(self, upto: np.ndarray) -> None:
         st = self.state
-        upto = jnp.asarray(upto, jnp.int32)
+        upto = self._put(upto, np.int32)
         self.state = st._replace(applied=jnp.maximum(
             st.applied, jnp.minimum(upto, st.commit)))
 
@@ -451,7 +470,7 @@ class DistMember:
         mask = np.ones(self.g, bool) if mask is None \
             else np.asarray(mask, bool)
         self.state = conf_change_batch(
-            self.state, jnp.full((self.g,), bool(add)),
-            jnp.full((self.g,), slot, jnp.int32),
-            jnp.full((self.g,), self.slot, jnp.int32),
-            active=jnp.asarray(mask))
+            self.state, self._full(bool(add), jnp.bool_),
+            self._full(slot),
+            self._full(self.slot),
+            active=self._put(mask))

@@ -376,7 +376,7 @@ class MultiRaft:
         # fault-free rounds reuse one device-resident all-False mask
         # instead of re-uploading an [M, M, G] array per call
         self._no_drop = jnp.zeros((m, m, g), bool)
-        self._sh_g = None     # set by shard(): NamedSharding for [G]
+        self._placer = None   # set by shard(): parallel.mesh placer
         self._sh_drop = None  # set by shard(): for [M, M, G] masks
 
     # -- intra-slice scale-out --------------------------------------------
@@ -390,7 +390,11 @@ class MultiRaft:
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
-        from ..parallel.mesh import check_group_divisible, shard_leading
+        from ..parallel.mesh import (
+            check_group_divisible,
+            leading_placer,
+            shard_leading,
+        )
 
         check_group_divisible(mesh, self.g)
         self.states = [
@@ -400,19 +404,17 @@ class MultiRaft:
             self._no_drop, NamedSharding(mesh, P(None, None, "g")))
         # Per-call [G] host inputs (leader routing, proposal counts,
         # campaign masks) must be PLACED with the same g-sharding
-        # before each dispatch: a bare jnp.asarray commits them to one
-        # device, and XLA then reshards/replicates the big sharded
-        # state arrays around the mismatch on EVERY call — measured as
-        # the 37x serving-vs-raw-step gap of VERDICT r3 weakness #3.
-        self._sh_g = NamedSharding(mesh, P("g"))
+        # before each dispatch (parallel.mesh.leading_placer's
+        # docstring has the measured why); the [M, M, G] fault masks
+        # shard their TRAILING axis and keep their own sharding.
+        self._placer = leading_placer(mesh)
         self._sh_drop = NamedSharding(mesh, P(None, None, "g"))
 
     def _put_g(self, arr, dtype=None):
         """[G] host array → device, g-sharded when the state is."""
-        a = np.asarray(arr, dtype)
-        if self._sh_g is not None:
-            return jax.device_put(a, self._sh_g)
-        return jnp.asarray(a)
+        if self._placer is not None:
+            return self._placer(arr, dtype)
+        return jnp.asarray(np.asarray(arr, dtype))
 
     def _put_drop(self, dense: np.ndarray):
         """[M, M, G] fault mask → device, g-sharded like _no_drop."""
