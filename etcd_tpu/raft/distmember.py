@@ -70,7 +70,19 @@ def _absorb_resp(state: GroupState, peer, term, ok, acked, hint,
                  active):
     """Leader absorbing one peer's batched msgAppResp: step down on
     higher terms, progress-update ok lanes, repair next_ from the
-    commit hint on rejects, then quorum-commit."""
+    commit hint on rejects, then quorum-commit.
+
+    The repair SETS next_ = hint + 1 in both directions.  The hint is
+    the follower's commit, so prev = hint is always verifiable there
+    (offset <= commit, and the compaction slot carries the offset
+    entry's term) and everything <= hint is immutable.  Clamping with
+    min(next_, hint+1) — the earlier form — deadlocks the lane when
+    response loss leaves the leader's next_ BELOW the follower's
+    commit+1 while the follower has lane-compacted to its commit: the
+    probe's prev sits below the follower's offset (term unknowable →
+    reject forever) and the min pins next_ there.  Found by the chaos
+    drill as a one-lane permanent replication wedge that survived
+    restarts of every host."""
     state = _adopt_term(state, term, jnp.full_like(term, -1), active)
     g, m = state.match.shape
     peer_v = jnp.full((g,), peer, jnp.int32)
@@ -80,8 +92,7 @@ def _absorb_resp(state: GroupState, peer, term, ok, acked, hint,
     reject = active & ~ok & (state.role == LEADER)
     repaired = jnp.maximum(hint + 1, 1)
     next_ = jnp.where(reject[:, None] & onehot[None, :],
-                      jnp.minimum(state.next_, repaired[:, None]),
-                      state.next_)
+                      repaired[:, None], state.next_)
     state = state._replace(next_=next_)
     return maybe_commit(state)
 
@@ -322,11 +333,12 @@ class DistMember:
         # reference's handleSnapshot reply, raft.go:418-424): the
         # follower durably holds everything at or below its commit,
         # and after a snapshot install this is what advances the
-        # leader's match/next past its compaction point.  The reject
-        # hint cannot do it — reject repair only moves next_ DOWN
-        # (backtracking), so without this the leader re-flags
-        # need_snap forever and the follower loops snapshot pulls
-        # (found by the chaos drill).
+        # leader's match/next past its compaction point.  (A reject's
+        # hint repair — _absorb_resp sets next_ = hint+1 — repairs
+        # next_, but a need_snap lane sends no append to reject, so
+        # without this positive ack the leader re-flags need_snap
+        # forever and the follower loops snapshot pulls — found by
+        # the chaos drill.)
         need = np.asarray(b.need_snap) & np.asarray(cur)
         commit_np = np.asarray(st.commit, dtype=np.int32)
         return AppendResp(

@@ -177,6 +177,10 @@ class DistServer:
         self._slot_ids: dict[int, int] = {}  # slot -> member id cache
         self._requeue: list[deque] = [deque() for _ in range(g)]
         self._need_pull = False      # snapshot catch-up requested
+        # one source of truth for election forensics (liveness beat +
+        # campaign-lost logging), read once at construction
+        self._debug_elections = bool(
+            os.environ.get("ETCD_DEBUG_ELECTIONS"))
         self._thread: threading.Thread | None = None
         self._httpd = None
         # Round-loop I/O plumbing that must NOT be rebuilt per round
@@ -766,7 +770,26 @@ class DistServer:
         next_tick = time.monotonic() + self.tick_interval
         next_sync = time.monotonic() + self.sync_interval
         batch: list[_Pending] = []
+        next_beat = 0.0  # ETCD_DEBUG_ELECTIONS liveness heartbeat
         while not self.done.is_set():
+            if self._debug_elections and \
+                    time.monotonic() >= next_beat:
+                next_beat = time.monotonic() + 2.0
+                st = self.mr.state
+                log.info(
+                    "dist[%d]: beat roles=%s elapsed=%s timeout=%s "
+                    "lead=%s term=%s commit=%s last=%s offset=%s "
+                    "next=%s match=%s", self.slot,
+                    np.asarray(st.role)[:8].tolist(),
+                    np.asarray(st.elapsed)[:8].tolist(),
+                    np.asarray(st.timeout)[:8].tolist(),
+                    np.asarray(st.lead)[:8].tolist(),
+                    np.asarray(st.term)[:8].tolist(),
+                    np.asarray(st.commit)[:8].tolist(),
+                    np.asarray(st.last)[:8].tolist(),
+                    np.asarray(st.offset)[:8].tolist(),
+                    np.asarray(st.next_)[:4].tolist(),
+                    np.asarray(st.match)[:4].tolist())
             batch = self._drain(timeout=min(
                 self.tick_interval,
                 max(next_tick - time.monotonic(), 0.001)))
@@ -790,9 +813,25 @@ class DistServer:
                                              id=r.id, group=0))
                 next_sync = now + self.sync_interval
             if now >= next_tick:
-                next_tick = now + self.tick_interval
+                # WALL-CLOCK ticking: when a loop iteration overran
+                # (CPU contention, a slow exchange), credit every
+                # missed tick instead of silently dropping it — a
+                # counted-ticks timer stretches the 1-2s election
+                # timeout to tens of seconds under load (observed as
+                # 15s leaderless windows in the batch chaos drill).
+                # The reference's timers are real-time (server.go:182
+                # time.Ticker).  Burst bounded: past 4x the worst-case
+                # timeout nothing new can fire.
+                behind = min(int((now - next_tick)
+                                 / self.tick_interval) + 1,
+                             8 * self.mr.election)
+                next_tick += behind * self.tick_interval
+                if next_tick < now:  # deep pause: resync the phase
+                    next_tick = now + self.tick_interval
                 with self.lock:
                     fire = self.mr.tick()
+                    for _ in range(behind - 1):
+                        fire = fire | self.mr.tick()
                     # a follower hearing appends has elapsed reset;
                     # lanes that fire lost their leader
                 if fire.any():
@@ -939,6 +978,19 @@ class DistServer:
         with self.lock:
             won = self.mr.tally(req.active, votes)
             self._persist_ballot()
+            lost = int(np.asarray(req.active).sum()) \
+                - int(won.sum())
+            if lost and self._debug_elections:
+                # liveness forensics (chaos drill): which lanes
+                # campaigned, how many peers answered, what they said
+                log.info(
+                    "dist[%d]: campaign lost %d lanes (fired=%s, "
+                    "resps=%d, grants=%s, terms=%s)", self.slot,
+                    lost, np.nonzero(np.asarray(req.active))[0][:8],
+                    len(votes),
+                    [np.asarray(v.granted).astype(int)[:8].tolist()
+                     for v in votes],
+                    np.asarray(self.mr.state.term)[:8])
             if won.any():
                 log.info("dist[%d]: won %d groups", self.slot,
                          int(won.sum()))

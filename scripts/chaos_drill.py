@@ -38,9 +38,16 @@ PEERS = [f"http://127.0.0.1:1785{i}" for i in range(3)]
 CLIENT = [f"http://127.0.0.1:1486{i}" for i in range(3)]
 CYCLES = int(sys.argv[1]) if len(sys.argv) > 1 else 6
 tear = "--tear" in sys.argv
+# --batch drives writes through POST /mraft/propose_many (the
+# pipelined do_many path) instead of single v2 PUTs — crash-tests the
+# batch endpoint's waiter cleanup: a kill -9 mid-batch must surface
+# per-request failures, never a fabricated ok for an uncommitted write
+batch_mode = "--batch" in sys.argv
+BATCH_W = 16
 
 env = dict(os.environ)
 env.update(JAX_PLATFORMS="cpu", ETCD_JAX_PLATFORMS="cpu",
+           ETCD_DEBUG_ELECTIONS="1",
            PYTHONPATH=f"{REPO}:/root/.axon_site")
 
 
@@ -70,6 +77,28 @@ def get(base, key, timeout=10):
     with urllib.request.urlopen(f"{base}/v2/keys{key}",
                                 timeout=timeout) as r:
         return json.loads(r.read())
+
+
+_BID = [1 << 48]
+
+
+def put_batch(slot, items, timeout=20):
+    """One /mraft/propose_many frame of (key, val) writes against the
+    PEER port of ``slot``; returns the per-item ok verdicts."""
+    from etcd_tpu.server.distserver import pack_requests
+    from etcd_tpu.wire.requests import Request
+
+    reqs = []
+    for k, v in items:
+        _BID[0] += 1
+        reqs.append(Request(method="PUT", id=_BID[0], path=k, val=v))
+    req = urllib.request.Request(
+        PEERS[slot] + "/mraft/propose_many",
+        data=pack_requests(reqs), method="POST",
+        headers={"Content-Type": "application/octet-stream"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        out = json.loads(r.read())
+    return [bool(d.get("ok")) for d in out]
 
 
 # key -> group coverage for the recovery probe (the 7 drill keys must
@@ -125,6 +154,28 @@ try:
         # liveness probe state: first post-kill ack time per group
         group_up = {}
         while time.time() < t_end:
+            if batch_mode:
+                items = []
+                for _ in range(BATCH_W):
+                    seq += 1
+                    key, val = KEYS[seq % 7], f"v{seq}"
+                    issued.setdefault(key, set()).add(val)
+                    items.append((key, val))
+                try:
+                    oks = put_batch(rng.choice(survivors), items,
+                                    timeout=5)
+                except Exception:
+                    fail += len(items)
+                    continue
+                for (key, val), okd in zip(items, oks):
+                    if okd:
+                        acked[key] = val
+                        ok += 1
+                        group_up.setdefault(
+                            group_of(key, N_GROUPS), time.time())
+                    else:
+                        fail += 1
+                continue
             seq += 1
             key = KEYS[seq % 7]
             val = f"v{seq}"
@@ -181,6 +232,29 @@ try:
             time.sleep(1)
         print(f"cycle {cycle}: s{victim} caught up: {caught}",
               flush=True)
+        if not caught:
+            # diagnostics before dying: per-key view on every host +
+            # each host's group frontiers (the snapshot endpoint
+            # serves the LIVE applied vector)
+            for i in range(3):
+                vals = {}
+                for k in issued:
+                    try:
+                        vals[k] = get(CLIENT[i], k)["node"]["value"]
+                    except Exception as e:
+                        vals[k] = f"<{type(e).__name__}>"
+                print(f"  s{i} keys: {vals}", flush=True)
+                try:
+                    with urllib.request.urlopen(
+                            PEERS[i] + "/mraft/snapshot",
+                            timeout=5) as r:
+                        d = json.loads(r.read())
+                    print(f"  s{i} frontier={d['frontier']} "
+                          f"applied_total={d.get('applied_total')}",
+                          flush=True)
+                except Exception as e:
+                    print(f"  s{i} snapshot probe: "
+                          f"{type(e).__name__}", flush=True)
         assert caught, f"s{victim} failed to catch up"
     assert not lost, lost
     rec = sorted(recovery)

@@ -157,6 +157,51 @@ def test_split_vote_lockstep_breaks_via_timeout_redraw():
     assert led_at.max() <= 50, f"slow convergence: {led_at}"
 
 
+def test_reject_repair_jumps_forward_past_compacted_probe():
+    """Chaos-drill regression (round 4): response loss can leave the
+    leader's next_[f] BELOW the follower's commit+1 while the
+    follower has lane-compacted to its commit (offset == commit ==
+    last).  The probe's prev then sits below the follower's offset —
+    unverifiable, rejected every round — and a min()-clamped repair
+    pinned next_ there FOREVER (a permanent one-lane replication
+    wedge that survived restarts of every host).  The repair must SET
+    next_ = hint+1, jumping forward."""
+    ms = make_cluster()
+    elect(ms, 0)
+    ms[0].propose(np.ones(G, np.int32), data=[[b""] for _ in range(G)])
+    for i in range(6):
+        ms[0].propose(np.ones(G, np.int32),
+                      data=[[bytes([i])] for _ in range(G)])
+        replicate(ms, 0)
+    replicate(ms, 0)  # commits propagate
+    lead, fol = ms[0], ms[1]
+    assert (fol.commit_index() >= 6).all()
+    # follower lane-compacts everything it applied (offset == commit)
+    fol.mark_applied(fol.commit_index())
+    fol.compact()
+    st = fol.state
+    assert (np.asarray(st.offset) == np.asarray(st.commit)).all()
+    # manufacture the stale leader view: next_[fol] one BELOW the
+    # follower's commit+1 (as left by a lost response under overload)
+    import jax.numpy as jnp
+
+    stale = jnp.asarray(np.asarray(fol.state.commit))  # = commit
+    lst = lead.state
+    next_ = np.asarray(lst.next_).copy()
+    next_[:, 1] = np.asarray(stale)
+    lead.state = lst._replace(next_=jnp.asarray(next_))
+    # new entries the follower must eventually receive
+    lead.propose(np.ones(G, np.int32), data=[[b"new"] for _ in range(G)])
+
+    before = fol.commit_index().copy()
+    for _ in range(4):  # reject -> forward repair -> append -> commit
+        replicate(ms, 0, drop={2})  # only the wedged pair exchanges
+    assert (fol.commit_index() > before).all(), \
+        (before, fol.commit_index())
+    assert fol.committed_payload(0, int(fol.commit_index()[0])) \
+        in (b"new", b"")
+
+
 def test_quorum_commits_with_one_peer_down():
     ms = make_cluster()
     elect(ms, 0)
