@@ -242,6 +242,28 @@ def progress_update(state: GroupState, from_slot, idx, active=None):
     return state._replace(match=match, next_=next_)
 
 
+def progress_repair(state: GroupState, from_slot, hint,
+                    active) -> GroupState:
+    """Leader handling a REJECTED msgAppResp: SET
+    ``next_[from] = hint + 1`` where ``hint`` is the follower's
+    commit — one-round repair instead of the reference's
+    decrement-by-one probe (raft.go:464-470).
+
+    Safe in BOTH directions: the committed prefix is immutable and
+    ``prev = hint`` is always verifiable at the follower (compaction
+    never outruns applied ≤ commit, and the compaction slot carries
+    the offset entry's term).  The SET matters — a min()-clamped
+    variant deadlocked a lane permanently when the leader's next_ was
+    stale-low against a follower that had compacted to its commit
+    (round-4 chaos-drill wedge; see distmember._absorb_resp)."""
+    g, m = state.match.shape
+    active = active & (state.role == LEADER)
+    onehot = jax.nn.one_hot(from_slot, m, dtype=bool) & active[:, None]
+    repaired = jnp.maximum(hint + 1, 1)
+    return state._replace(next_=jnp.where(
+        onehot, repaired[:, None], state.next_))
+
+
 @jax.jit
 def maybe_commit(state: GroupState) -> GroupState:
     """Quorum commit advance (raft.go:248-258 + log.go:88-95) for all

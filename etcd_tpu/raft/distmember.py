@@ -45,6 +45,7 @@ from .batched import (
     leader_append,
     maybe_append,
     maybe_commit,
+    progress_repair,
     progress_update,
     restore_snapshot,
     term_at,
@@ -84,16 +85,11 @@ def _absorb_resp(state: GroupState, peer, term, ok, acked, hint,
     drill as a one-lane permanent replication wedge that survived
     restarts of every host."""
     state = _adopt_term(state, term, jnp.full_like(term, -1), active)
-    g, m = state.match.shape
+    g, _m = state.match.shape
     peer_v = jnp.full((g,), peer, jnp.int32)
     state = progress_update(state, peer_v, acked,
                             active=active & ok)
-    onehot = jnp.arange(m) == peer
-    reject = active & ~ok & (state.role == LEADER)
-    repaired = jnp.maximum(hint + 1, 1)
-    next_ = jnp.where(reject[:, None] & onehot[None, :],
-                      repaired[:, None], state.next_)
-    state = state._replace(next_=next_)
+    state = progress_repair(state, peer_v, hint, active=active & ~ok)
     return maybe_commit(state)
 
 

@@ -48,6 +48,7 @@ from .batched import (
     compact as compact_batch,
     maybe_append,
     maybe_commit,
+    progress_repair,
     progress_update,
     restore_snapshot,
     term_at,
@@ -177,18 +178,16 @@ def _round_core(states, sels, n_new, drop, e, slots):
             pst = pst._replace(elapsed=jnp.where(send, 0, pst.elapsed))
             states[peer] = pst
             # msgAppResp: success → progress update; reject →
-            # decrement next (raft.go:464-470 batched); the response
-            # direction drops independently
+            # progress_repair jumps next_ to the follower's commit+1
+            # (one round instead of the reference's decrement-by-one
+            # probe — see the helper's docstring for the safety
+            # argument and the wedge the SET semantics prevent)
             resp_ok = send & ~drop[peer, slot]
             acked = prev_idx + n_send
             lst = progress_update(lst, peer_v, acked,
                                   active=resp_ok & ok)
-            reject = resp_ok & ~ok
-            onehot = jnp.arange(m) == peer
-            dec = jnp.maximum(nxt - 1, 1)
-            lst = lst._replace(next_=jnp.where(
-                reject[:, None] & onehot[None, :],
-                dec[:, None], lst.next_))
+            lst = progress_repair(lst, peer_v, pst.commit,
+                                  active=resp_ok & ~ok)
         lst = maybe_commit(lst)
         states[slot] = lst
 

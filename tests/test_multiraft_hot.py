@@ -81,6 +81,41 @@ def test_mixed_routing_falls_back_to_general():
     assert (total > 0).all()
 
 
+def test_lagging_member_catches_up_bandwidth_bound():
+    """Reject repair (progress_repair) jumps next_ to the follower's
+    commit+1, so a REJECTING lagging member catches up in ~gap/e send
+    rounds (bandwidth-bound), not gap probe rounds (the reference's
+    decrement-by-one).  The reject path is forced by deposing the
+    leader: the new leader's fresh next_ = its own last+1 probes far
+    beyond the laggard's log, which must REJECT (not silently accept)
+    and be repaired in ONE round."""
+    mr = MultiRaft(g=G, m=3, cap=128, max_batch_ents=4, seed=2)
+    mr.campaign(0)
+    mr.propose(np.ones(G, np.int32), data=[[b""] for _ in range(G)])
+    gap = 24  # >> e=4
+    for i in range(gap):
+        mr.propose(np.ones(G, np.int32),
+                   data=[[bytes([i])] for _ in range(G)],
+                   drop={(0, 2): np.ones(G, bool),
+                         (2, 0): np.ones(G, bool)})
+    # depose slot 0: slot 1 (fully replicated) campaigns and wins;
+    # its next_ for EVERY peer resets to last+1, so its first probe
+    # to the laggard is rejected — the forward repair must land on
+    # the laggard's commit+1 immediately
+    won = mr.campaign(1)
+    assert won.all()
+    lead_commit = np.asarray(mr.states[1].commit).copy()
+    member2 = np.asarray(mr.states[2].commit).copy()
+    assert (lead_commit - member2 >= gap - 4).all()
+    # one reject+repair round, then ceil(gap/e) streaming rounds
+    # (+1 for the commit to propagate); decrement-by-one would need
+    # ~gap probe rounds before any entry flows
+    rounds_needed = 2 + -(-int((lead_commit - member2).max()) // mr.e)
+    for _ in range(rounds_needed):
+        mr.replicate()
+    assert (np.asarray(mr.states[2].commit) >= lead_commit).all()
+
+
 def test_overflow_lane_parity():
     """Overflow error lanes report identically in both programs."""
     hot, gen = _mk(False), _mk(True)
