@@ -437,16 +437,27 @@ class DistServer:
             except Exception:
                 self.done.wait(1.0)  # no leader yet; retry
 
-    def stop(self) -> None:
+    def stop(self) -> bool:
+        """Stop the server.  Returns True on a clean stop; False when
+        the round loop failed to exit within the join timeout — the
+        WAL is then left open (a closed WAL would raise mid-save when
+        the loop unwedges) and the data dir MUST NOT be reused by a
+        new server in this process until the loop actually exits."""
         self.done.set()
         self._queue.put(None)
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()  # release the port for rebinds
+        loop_exited = True
         if self._thread is not None \
                 and self._thread is not threading.current_thread():
             self._thread.join(timeout=10)
-        self._xchg_pool.shutdown(wait=False)
+            loop_exited = not self._thread.is_alive()
+        if loop_exited:
+            self._xchg_pool.shutdown(wait=False)
+        # else: a wedged round loop still owns the pool — leave it up
+        # so its next _exchange doesn't die on "cannot schedule new
+        # futures after shutdown"; _exchange also guards on self.done.
         with self._conn_lock:
             conns = list(self._peer_conns.values())
             self._peer_conns.clear()
@@ -455,8 +466,20 @@ class DistServer:
                 conn.close()
             except Exception:
                 pass
-        with self.lock:
-            self.wal.close()
+        if loop_exited:
+            with self.lock:
+                self.wal.close()
+        else:
+            # the wedged loop may still _persist when it unwedges — a
+            # closed WAL would raise mid-save.  Leaving it open is
+            # safe for durability (every save() fsyncs, nothing is
+            # buffered between saves) but the caller must not reuse
+            # the data dir in-process: two appenders would interleave
+            # one segment's CRC chain.
+            log.warning("dist[%d]: stop(): round loop still running "
+                        "after join timeout; WAL left open — do not "
+                        "reuse this data dir in-process", self.slot)
+        return loop_exited
 
     # -- durability helpers (call with self.lock held) --------------------
 
@@ -957,6 +980,8 @@ class DistServer:
         with tracer.span("dist.exchange"):
             resps = self._exchange(frames)
 
+        if self.done.is_set():
+            return  # stopping: don't absorb/persist past stop()
         with self.lock:
             with tracer.span("dist.absorb"):
                 for r in resps:
@@ -975,6 +1000,8 @@ class DistServer:
         votes = [v for v in self._exchange(
             [(p, payload) for p in range(self.m) if p != self.slot])
             if isinstance(v, VoteResp)]
+        if self.done.is_set():
+            return  # stopping: don't tally/persist past stop()
         with self.lock:
             won = self.mr.tally(req.active, votes)
             self._persist_ballot()
@@ -1022,6 +1049,8 @@ class DistServer:
         /v2/stats/leader keyed by member id."""
         if not frames:
             return []
+        if self.done.is_set():
+            return []  # stop() may have shut the pool down already
 
         def one(arg):
             peer, payload = arg
@@ -1043,8 +1072,14 @@ class DistServer:
                     time.perf_counter() - t0)
             return parsed
 
-        return [r for r in self._xchg_pool.map(one, frames)
-                if r is not None]
+        try:
+            return [r for r in self._xchg_pool.map(one, frames)
+                    if r is not None]
+        except RuntimeError:
+            # stop() shut the pool between the done-check and map()
+            if self.done.is_set():
+                return []
+            raise
 
     def _member_id(self, slot: int) -> int:
         """Stats key for peer ``slot``: its registered member id when
@@ -1073,7 +1108,16 @@ class DistServer:
         there is a dropped message, as before.  The cache is popped
         for the duration of the call (concurrent callers racing on a
         peer each get their own connection; the store-back closes any
-        connection another caller parked meanwhile)."""
+        connection another caller parked meanwhile).
+
+        Delivery contract: AT-LEAST-ONCE.  The retry cannot tell "the
+        peer closed the idle socket before my bytes arrived" from
+        "the peer processed the POST and the response was lost", so a
+        processed frame may be re-sent.  Every current payload is
+        idempotent (raft append/vote frames are prefix-verified and
+        term-guarded; snapshot pulls are reads) — do NOT route a
+        non-idempotent peer operation through this helper without
+        adding a dedup key at the receiver."""
         import http.client
 
         url = self.peer_urls[peer]

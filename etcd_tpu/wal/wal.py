@@ -320,21 +320,64 @@ class WAL:
                 # stream's last bytes (the chain is exhausted
                 # mid-record), so every byte from the record start to
                 # the end of the chain is part of the torn record —
-                # truncate the file it starts in AND empty any later
+                # truncate the file it starts in AND remove any later
                 # files its bytes spilled into (unreachable from a
                 # single crash since writes never span segments, but
                 # repair exists for arbitrary crash states)
                 if repair:
                     fi, off = self.decoder.good
+                    if off == 0 and fi == 0:
+                        # the tear consumes the very head of the
+                        # decoder's first file: nothing in the read
+                        # window is salvageable, and truncating would
+                        # manufacture a headless zero-byte segment
+                        # (no CRC/metadata records — mid-chain opens
+                        # would then corrupt the CRC chain, full
+                        # opens would lose node metadata).  Refuse:
+                        # nothing here was ever synced+acked.
+                        raise
+                    if off == 0 and fi > 0:
+                        # the tear starts at byte 0 of segment fi:
+                        # truncating would leave a headless segment
+                        # (no CRC/metadata records) that a later
+                        # mid-chain open would reject — the chain
+                        # ended exactly at fi-1's end, so fi itself
+                        # is all torn bytes; drop it too
+                        fi, off = fi - 1, None
                     path = self.decoder.files[fi].name
-                    os.truncate(path, off)
-                    for later in self.decoder.files[fi + 1:]:
-                        os.truncate(later.name, 0)
+                    if off is not None:
+                        os.truncate(path, off)
+                    doomed = self.decoder.files[fi + 1:]
+                    # REMOVE, don't truncate-to-zero: a zero-length
+                    # segment carries no metadata/CRC head record and
+                    # would break any per-file validation on a later
+                    # open (advisor r4).  Descending order with a
+                    # directory fsync after EACH unlink keeps any
+                    # crash-surviving subset seq-contiguous — without
+                    # the per-remove fsync the journal may persist
+                    # the unlinks out of call order, stranding a gap
+                    # that bricks every subsequent open.
+                    if doomed:
+                        dfd = os.open(self.dir, os.O_RDONLY)
+                        try:
+                            for lf in reversed(doomed):
+                                os.remove(lf.name)
+                                os.fsync(dfd)
+                        finally:
+                            os.close(dfd)
+                        # appends must continue in the surviving
+                        # segment — self.f was opened on the last
+                        # (now removed) file
+                        self.f.close()
+                        self.f = _open_append_0600(path)
+                        self.seq, _ = parse_wal_name(
+                            os.path.basename(path))
                     log.warning(
-                        "wal: repaired torn tail: truncated %s at "
-                        "byte %d, emptied %d later file(s) (%s)",
-                        os.path.basename(path), off,
-                        len(self.decoder.files) - fi - 1, e)
+                        "wal: repaired torn tail: kept %s%s, removed "
+                        "%d later file(s) (%s)",
+                        os.path.basename(path),
+                        "" if off is None else f" (cut at byte {off})",
+                        len(doomed), e)
                     repaired = True
                     return None
                 raise

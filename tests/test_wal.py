@@ -275,8 +275,9 @@ def test_torn_tail_repair(tmp_path):
 def test_torn_tail_repair_spans_segments(tmp_path):
     """A torn record whose claimed length spills past the file it
     starts in consumes every later file's bytes; repair must truncate
-    the starting file AND empty the later files, or the 'repaired'
-    directory misparses on the next open (advisor r3 finding).
+    the starting file AND remove the later files (zero-length husks
+    carry no metadata/CRC head record — advisor r3+r4 findings), or
+    the 'repaired' directory misparses on the next open.
     Unreachable from a single crash (writes never span segments) but
     repair exists for arbitrary crash states."""
     import struct
@@ -311,12 +312,83 @@ def test_torn_tail_repair_spans_segments(tmp_path):
     # record — everything from the tear forward is discarded
     assert [e.index for e in got] == [0, 1, 2]
     assert os.path.getsize(f0) == f0_size  # torn splice removed
-    assert os.path.getsize(f1) == 0        # later file emptied
-    # the repaired WAL appends (into the emptied tail segment) and
-    # replays cleanly on the next open
+    assert not os.path.exists(f1)          # later file REMOVED
+    # the repaired WAL appends (into the surviving segment) and
+    # replays cleanly on the next open — including across a fresh
+    # cut, which must number from the surviving seq, not the
+    # removed one's
     w2.save(HardState(term=1, vote=0, commit=3),
             [Entry(term=1, index=3, data=b"replacement")])
+    w2.cut()
+    w2.save_entry(Entry(term=1, index=4, data=b"post-repair-cut"))
+    w2.sync()
+    w2.close()
+    names2 = sorted(os.listdir(d))
+    assert len(names2) == 2 and names2[0] == os.path.basename(f0)
+    _, _, again = WAL.open_at_index(d, 0).read_all()
+    assert [e.index for e in again] == [0, 1, 2, 3, 4]
+    assert again[-1].data == b"post-repair-cut"
+
+
+def test_torn_tail_repair_at_segment_head_drops_segment(tmp_path):
+    """A tear starting at byte 0 of a later segment must drop that
+    segment entirely — truncating it to 0 would leave a headless
+    husk (no CRC/metadata records) that a later open rejects
+    (advisor r4 / review finding)."""
+    import struct
+
+    d = str(tmp_path / "wal")
+    w = WAL.create(d, b"meta")
+    w.save(HardState(term=1, vote=0, commit=2),
+           [Entry(term=1, index=i, data=bytes([i]) * 50)
+            for i in range(3)])
+    w.cut()
+    w.sync()
+    w.close()
+    names = sorted(os.listdir(d))
+    f0, f1 = (os.path.join(d, n) for n in names)
+    f0_size = os.path.getsize(f0)
+
+    # replace segment 1 wholesale with a torn record at its byte 0
+    # whose length claim exceeds the bytes present
+    with open(f1, "wb") as fh:
+        fh.write(struct.pack("<q", 4096) + b"\xBB" * 10)
+
+    w2 = WAL.open_at_index(d, 0)
+    md, st, got = w2.read_all(repair=True)
+    assert md == b"meta"
+    assert [e.index for e in got] == [0, 1, 2]
+    assert os.path.getsize(f0) == f0_size  # untouched
+    assert not os.path.exists(f1)          # headless husk removed
+    # appends continue in segment 0 and replay cleanly
+    w2.save(HardState(term=1, vote=0, commit=3),
+            [Entry(term=1, index=3, data=b"after-head-tear")])
     w2.close()
     _, _, again = WAL.open_at_index(d, 0).read_all()
     assert [e.index for e in again] == [0, 1, 2, 3]
-    assert again[-1].data == b"replacement"
+
+
+def test_torn_tail_at_first_file_head_refuses_repair(tmp_path):
+    """A tear consuming byte 0 of the decoder's FIRST file leaves
+    nothing salvageable in the read window; repair must refuse (raise)
+    rather than truncate-to-zero — a zero-byte segment has no
+    CRC/metadata head records, so 'repairing' it would silently lose
+    node metadata on a full open and corrupt the CRC chain on a
+    mid-chain open (review finding)."""
+    import struct
+
+    from etcd_tpu.wal.errors import TornTailError
+
+    d = str(tmp_path / "wal")
+    w = WAL.create(d, b"meta")
+    w.sync()
+    w.close()
+    names = sorted(os.listdir(d))
+    f0 = os.path.join(d, names[0])
+    with open(f0, "wb") as fh:  # replace the whole file with a tear
+        fh.write(struct.pack("<q", 4096) + b"\xCC" * 10)
+    size = os.path.getsize(f0)
+
+    with pytest.raises(TornTailError):
+        WAL.open_at_index(d, 0).read_all(repair=True)
+    assert os.path.getsize(f0) == size  # untouched, not husked
