@@ -100,6 +100,10 @@ def _load():
         lib.etcd_replay_verify.restype = ctypes.c_int64
         lib.etcd_replay_verify.argtypes = [u8p, ctypes.c_uint64,
                                            ctypes.c_uint32, u64p, u64p]
+        lib.etcd_chain_verify.restype = ctypes.c_int64
+        lib.etcd_chain_verify.argtypes = [u8p, ctypes.c_uint64, u64p,
+                                          u64p, u32p, ctypes.c_uint64,
+                                          ctypes.c_uint32]
         lib.etcd_wal_gen.restype = ctypes.c_int64
         lib.etcd_wal_gen.argtypes = [ctypes.c_uint64, ctypes.c_uint64,
                                      ctypes.c_uint64, ctypes.c_uint32,
@@ -200,6 +204,26 @@ def replay_verify(blob: np.ndarray, seed: int = 0):
     n = _check(lib.etcd_replay_verify(
         _u8(blob), blob.size, seed, ctypes.byref(li), ctypes.byref(lt)))
     return n, li.value, lt.value
+
+
+def chain_verify(blob: np.ndarray, data_off: np.ndarray,
+                 data_len: np.ndarray, stored: np.ndarray,
+                 seed: int = 0) -> int:
+    """CRC-only rolling-chain verification over pre-scanned record
+    spans (one native sweep; no re-parse).  Returns ``stored.size``
+    when the chain verifies, else the index of the first bad record;
+    raises on out-of-range spans."""
+    lib = _load()
+    if lib is None:
+        raise NativeError("native library unavailable")
+    u64 = ctypes.POINTER(ctypes.c_uint64)
+    return _check(lib.etcd_chain_verify(
+        _u8(blob), blob.size,
+        np.ascontiguousarray(data_off, np.uint64).ctypes.data_as(u64),
+        np.ascontiguousarray(data_len, np.uint64).ctypes.data_as(u64),
+        np.ascontiguousarray(stored, np.uint32).ctypes.data_as(
+            ctypes.POINTER(ctypes.c_uint32)),
+        data_off.size, seed))
 
 
 def wal_gen(n_entries: int, payload_len: int, start_index: int = 1,

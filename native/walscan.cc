@@ -251,6 +251,27 @@ uint32_t etcd_crc32c_raw(uint32_t state, const uint8_t* data, uint64_t len) {
   return raw(state, data, len);
 }
 
+// Rolling-chain CRC verification over pre-scanned record spans
+// (decoder.go:28-47 chain semantics, CRC work only — the framing and
+// proto parse already happened in etcd_wal_scan, so the
+// no-accelerator replay path pays exactly one parse sweep plus one
+// CRC sweep instead of re-parsing every record).  Returns `count`
+// when the whole chain verifies, the index of the first bad record
+// otherwise, or kErrTruncated for an out-of-range span.
+int64_t etcd_chain_verify(const uint8_t* buf, uint64_t n,
+                          const uint64_t* doff, const uint64_t* dlen,
+                          const uint32_t* stored, uint64_t count,
+                          uint32_t seed) {
+  uint32_t chain = seed;
+  for (uint64_t i = 0; i < count; i++) {
+    uint64_t o = doff[i], l = dlen[i];
+    if (o > n || l > n - o) return kErrTruncated;
+    chain = go_update(chain, buf + o, l);
+    if (stored[i] != chain) return static_cast<int64_t>(i);
+  }
+  return static_cast<int64_t>(count);
+}
+
 // Batched GroupEntry parse for multi-group restart replay: given the
 // record-data spans a WAL scan produced (each = one marshaled Entry),
 // locate the Entry's data field and extract the GroupEntry envelope's

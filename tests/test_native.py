@@ -142,3 +142,19 @@ def test_wal_count_exact():
     blob = native.wal_gen(37, 16)
     types, *_ = native.wal_scan(blob)
     assert types.shape == (37,)
+
+
+def test_chain_verify_clean_and_first_bad():
+    """native.chain_verify: CRC-only sweep over scanned spans —
+    returns count when clean, the first bad index otherwise
+    (walscan.cc etcd_chain_verify)."""
+    if not native.available():
+        pytest.skip("native library unavailable")
+    blob = native.wal_gen(50, 64, start_index=1, seed=7)
+    types, crcs, doff, dlen, *_ = native.wal_scan(blob)
+    assert native.chain_verify(blob, doff, dlen, crcs, seed=7) == 50
+
+    # flip a payload byte in record 20: records 0-19 verify, 20 fails
+    bad = blob.copy()
+    bad[int(doff[20]) + 3] ^= 0xFF
+    assert native.chain_verify(bad, doff, dlen, crcs, seed=7) == 20

@@ -291,11 +291,17 @@ def test_native_error_maps_to_walerror(tmp_path, monkeypatch):
             read_all_device(str(d), 0)
 
 
-def test_big_record_small_byte_budget(tmp_path):
+def test_big_record_small_byte_budget(tmp_path, monkeypatch):
     """Width classes above byte_budget chunk down to few-row (even
     1-row) batches instead of flooring at 256 rows of multi-MiB
-    padding (advisor finding: host-chunk OOM risk)."""
+    padding (advisor finding: host-chunk OOM risk).  Forces the
+    batched pass — on CPU-pinned CI the native fast path would skip
+    the chunking code this test guards."""
+    from etcd_tpu.wal import replay_device
     from etcd_tpu.wal.replay_device import verify_chain_device
+
+    monkeypatch.setattr(replay_device, "_accelerator_absent",
+                        lambda: False)
 
     d = tmp_path / "wal"
     w = WAL.create(str(d), b"m")
@@ -316,9 +322,14 @@ def test_big_record_small_byte_budget(tmp_path):
                         byte_budget=1 << 17)
 
 
-def test_mixed_width_records(tmp_path):
+def test_mixed_width_records(tmp_path, monkeypatch):
     """One huge record must not inflate every row's padding: width
-    classes keep the batch allocatable and the chain still verifies."""
+    classes keep the batch allocatable and the chain still verifies.
+    Forces the batched pass (see test_big_record_small_byte_budget)."""
+    from etcd_tpu.wal import replay_device
+
+    monkeypatch.setattr(replay_device, "_accelerator_absent",
+                        lambda: False)
     d = tmp_path / "wal"
     w = WAL.create(str(d), b"m")
     for i in range(50):
@@ -359,3 +370,50 @@ def test_zero_tag_rejected_identically_on_all_lanes():
     if native.available():
         with pytest.raises(native.NativeError):
             native.wal_scan(arr)
+
+
+def test_cpu_backend_routes_chain_verify_to_native(tmp_path,
+                                                   monkeypatch):
+    """Without an accelerator the chain verification must run on the
+    native sequential verifier (~50x one JAX-CPU pass), not the
+    batched bit-matmul — the framework must never lose to the
+    reference on any backend (VERDICT r4 #2).  Tests run CPU-pinned,
+    so this asserts the production routing directly."""
+    if not native.available():
+        pytest.skip("native library unavailable")
+    d = tmp_path / "wal"
+    _write_wal(d)
+
+    calls = {"native": 0, "device": 0}
+    real_cv = native.chain_verify
+    monkeypatch.setattr(
+        native, "chain_verify",
+        lambda *a, **k: calls.__setitem__("native",
+                                          calls["native"] + 1)
+        or real_cv(*a, **k))
+    from etcd_tpu.ops import crc_device
+
+    real_rcb = crc_device.raw_crc_batch
+    monkeypatch.setattr(
+        crc_device, "raw_crc_batch",
+        lambda *a, **k: calls.__setitem__("device",
+                                          calls["device"] + 1)
+        or real_rcb(*a, **k))
+
+    md, st, block = read_all_device(str(d), 0)
+    assert md == b"meta-bytes" and len(block) == 20
+    assert calls["native"] == 1
+    assert calls["device"] == 0
+
+
+def test_cpu_backend_corruption_still_names_record(tmp_path):
+    """The native chain sweep must name the first bad record in the
+    raised error exactly like the batched pass does."""
+    d = tmp_path / "wal"
+    _write_wal(d, cuts=())
+    path = d / sorted(os.listdir(d))[0]
+    raw = bytearray(path.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    path.write_bytes(bytes(raw))
+    with pytest.raises(CRCMismatchError, match="at record"):
+        read_all_device(str(d), 0)
