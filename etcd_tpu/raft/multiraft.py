@@ -133,31 +133,48 @@ def _round_core(states, sels, n_new, drop, e, slots):
             # slow follower fell behind the leader's compaction
             # point: send a snapshot instead (raft.go:207-209,
             # needSnapshot :556); the follower's log collapses to
-            # the leader's offset entry and normal appends resume
+            # the leader's offset entry and normal appends resume.
+            # The whole install path runs under lax.cond — in the
+            # serving steady state no lane ever needs a snapshot, and
+            # the masked [G, cap] log-collapse write was ~1/3 of each
+            # exchange's memory traffic (round-5 profile: the
+            # per-follower exchange is the serving round's cost)
             needs_snap = send & (nxt <= lst.offset) & (lst.offset > 0)
-            snap_term = term_at(lst.log_term, lst.offset, lst.last,
-                                lst.offset)
-            follower_commit = pst.commit
-            pst, installed = restore_snapshot(
-                pst, lst.offset, snap_term,
-                commit=jnp.minimum(lst.commit, lst.offset),
-                active=needs_snap, members=lst.members)
-            # installed lanes ack the snapshot index; lanes that
-            # rejected (commit already past it) reply with their
-            # commit, repairing the leader's stale next_ without any
-            # truncation (raft.go:419-424).  Both acks ride the
-            # response edge — droppable like any msgAppResp.
-            snap_ack = ~drop[peer, slot]
             peer_v = jnp.full((g,), peer, jnp.int32)
-            lst = progress_update(lst, peer_v, lst.offset,
-                                  active=installed & snap_ack)
-            rejected = needs_snap & ~installed
-            lst = progress_update(lst, peer_v, follower_commit,
-                                  active=rejected & snap_ack)
-            nxt = jnp.where(
-                installed & snap_ack, lst.offset + 1,
-                jnp.where(rejected & snap_ack, follower_commit + 1,
-                          nxt))
+
+            def with_snap(operand, lst=lst, needs_snap=needs_snap,
+                          peer_v=peer_v, peer=peer, slot=slot):
+                pst, nxt = operand
+                snap_term = term_at(lst.log_term, lst.offset,
+                                    lst.last, lst.offset)
+                follower_commit = pst.commit
+                pst, installed = restore_snapshot(
+                    pst, lst.offset, snap_term,
+                    commit=jnp.minimum(lst.commit, lst.offset),
+                    active=needs_snap, members=lst.members)
+                # installed lanes ack the snapshot index; lanes that
+                # rejected (commit already past it) reply with their
+                # commit, repairing the leader's stale next_ without
+                # any truncation (raft.go:419-424).  Both acks ride
+                # the response edge — droppable like any msgAppResp.
+                snap_ack = ~drop[peer, slot]
+                upd = progress_update(lst, peer_v, lst.offset,
+                                      active=installed & snap_ack)
+                rejected = needs_snap & ~installed
+                upd = progress_update(upd, peer_v, follower_commit,
+                                      active=rejected & snap_ack)
+                nxt = jnp.where(
+                    installed & snap_ack, lst.offset + 1,
+                    jnp.where(rejected & snap_ack,
+                              follower_commit + 1, nxt))
+                return (pst, nxt), (upd.next_, upd.match)
+
+            def no_snap(operand, lst=lst):
+                return operand, (lst.next_, lst.match)
+
+            (pst, nxt), (l_next, l_match) = jax.lax.cond(
+                needs_snap.any(), with_snap, no_snap, (pst, nxt))
+            lst = lst._replace(next_=l_next, match=l_match)
 
             prev_idx = nxt - 1
             prev_term = term_at(lst.log_term, lst.offset, lst.last,
@@ -367,6 +384,8 @@ class MultiRaft:
         # (campaign wins, conf-change removals) — the round dispatch
         # picks the 1/M-work hot-slot program when it is set
         self._route_hot: int | None = None
+        self._hot_sel = None  # cached device router mask (see
+        # _hot_sel_dev)
         # host-side payload store: per-group dict index -> bytes
         self.payloads: list[dict[int, bytes]] = [dict() for _ in range(g)]
         self.errors = {"overflow": np.zeros(g, bool),
@@ -407,6 +426,7 @@ class MultiRaft:
         # docstring has the measured why); the [M, M, G] fault masks
         # shard their TRAILING axis and keep their own sharding.
         self._placer = leading_placer(mesh)
+        self._hot_sel = None  # placement changed: rebuild the mask
         self._sh_drop = NamedSharding(mesh, P(None, None, "g"))
 
     def _put_g(self, arr, dtype=None):
@@ -426,6 +446,16 @@ class MultiRaft:
         self._route_hot = mx if mx >= 0 and bool(
             ((self.leader == mx) | (self.leader == -1)).all()) \
             else None
+        self._hot_sel = None  # device router mask follows the routing
+
+    def _hot_sel_dev(self, hot: int):
+        """Device-resident ``leader == hot`` router mask, cached
+        until the routing changes — re-placing a [G] host bool per
+        dispatch was measurable serving overhead (round-5 profile)."""
+        sel = self._hot_sel
+        if sel is None:
+            sel = self._hot_sel = self._put_g(self.leader == hot)
+        return sel
 
     # -- elections (batched, fused, droppable) ---------------------------
 
@@ -478,8 +508,7 @@ class MultiRaft:
             hot = self._route_hot
             states, newly, valid, base, overflow, conflict = \
                 _fused_round_hot(
-                    tuple(self.states),
-                    self._put_g(self.leader == hot),
+                    tuple(self.states), self._hot_sel_dev(hot),
                     self._put_g(n_new), dense, e=self.e, slot=hot)
         else:
             states, newly, valid, base, overflow, conflict = \
@@ -487,8 +516,10 @@ class MultiRaft:
                     tuple(self.states), self._put_g(self.leader),
                     self._put_g(n_new), dense, e=self.e)
         self.states = list(states)
-        self.errors["overflow"] = np.asarray(overflow)
-        self.errors["conflict"] = np.asarray(conflict)
+        # lazy device arrays, same as propose_rounds: consumers call
+        # .any()/np.asarray when (if) they actually look
+        self.errors["overflow"] = overflow
+        self.errors["conflict"] = conflict
         # payloads recorded only for groups whose addressed member
         # really IS leader (a deposed member may linger in
         # self.leader), keyed from its pre-append last index; the
@@ -522,8 +553,7 @@ class MultiRaft:
         if self._route_hot is not None:
             hot = self._route_hot
             states, newly, overflow, conflict = _fused_multi_round_hot(
-                tuple(self.states),
-                self._put_g(self.leader == hot),
+                tuple(self.states), self._hot_sel_dev(hot),
                 self._put_g(n_new, np.int32), dense,
                 e=self.e, k=rounds, slot=hot)
         else:
@@ -532,8 +562,11 @@ class MultiRaft:
                 self._put_g(n_new, np.int32), dense,
                 e=self.e, k=rounds)
         self.states = list(states)
-        self.errors["overflow"] = np.asarray(overflow)
-        self.errors["conflict"] = np.asarray(conflict)
+        # device arrays, materialized lazily by consumers (np.asarray
+        # / .any() work transparently) — two eager [G] gathers per
+        # dispatch were measurable serving overhead on the mesh
+        self.errors["overflow"] = overflow
+        self.errors["conflict"] = conflict
         return np.asarray(newly)
 
     def replicate(self, drop=None) -> np.ndarray:
