@@ -130,6 +130,25 @@ issued = {}   # key -> set of ALL issued values (acked or timed out:
 seq = 0
 lost = []
 recovery = []  # per-cycle: seconds from kill to all-groups-writable
+decomp = []    # per (cycle, group) that re-elected: component delays
+unaffected = []  # client-ack delay for groups that kept their leader
+               # (pure probe-resolution baseline)
+decomp_fetch_failures = 0  # cycles whose /mraft/leaders fetch failed
+
+
+def fetch_leaders(slots):
+    """GET /mraft/leaders from each slot: the server-side
+    leadership-transition trace (election wall time + first
+    post-election apply per group)."""
+    out = {}
+    for s in slots:
+        try:
+            with urllib.request.urlopen(PEERS[s] + "/mraft/leaders",
+                                        timeout=5) as r:
+                out[s] = json.loads(r.read())
+        except Exception:
+            pass
+    return out
 
 try:
     for cycle in range(CYCLES):
@@ -197,6 +216,48 @@ try:
             # a group never recovered inside the window — record the
             # full window as a (pessimistic) lower bound
             recovery.append(time.time() - t_kill)
+        # kill->writable decomposition (VERDICT r4 #3): for every
+        # group that re-elected after the kill, split the
+        # client-observed window into election delay (kill -> a
+        # survivor wins the lane's election), server-writable delay
+        # (kill -> first post-election apply), and the remainder
+        # (the drill's own sequential 3s-timeout probe resolution)
+        leaders = fetch_leaders(survivors)
+        partial = len(leaders) < len(survivors)
+        if partial:
+            # a failed trace fetch must be loud, not fold the cycle
+            # into the 'unaffected' baseline — and the final
+            # server-writable gate checks decomposition coverage.
+            # Partial counts too: a lane whose election the MISSING
+            # survivor won would otherwise read as unaffected.
+            decomp_fetch_failures += 1
+            print(f"cycle {cycle}: /mraft/leaders fetch failed on "
+                  f"{len(survivors) - len(leaders)}/{len(survivors)}"
+                  f" survivors (decomposition "
+                  f"{'partial' if leaders else 'skipped'})",
+                  flush=True)
+        for g in range(N_GROUPS) if leaders else []:
+            best = None
+            for s, d in leaders.items():
+                if d["elected_at"][g] > t_kill and (
+                        best is None
+                        or d["elected_term"][g] > best[0]):
+                    best = (d["elected_term"][g], d["elected_at"][g],
+                            d["first_apply_at"][g])
+            cs = group_up[g] - t_kill if g in group_up else None
+            if best is not None:
+                decomp.append({
+                    "cycle": cycle, "group": g,
+                    "elect_s": round(best[1] - t_kill, 3),
+                    "writable_s": round(best[2] - t_kill, 3)
+                    if best[2] > 0 else None,
+                    "client_s": round(cs, 3)
+                    if cs is not None else None})
+            elif cs is not None and not partial:
+                unaffected.append(cs)
+            # on a partial fetch a no-election lane is unattributable
+            # (the missing survivor may have won it) — drop it rather
+            # than pollute the baseline
         # every key's current value must be SOME issued write (a
         # fabricated or lost value is a real safety violation; a
         # late-committing timed-out write is not)
@@ -272,7 +333,57 @@ try:
     bound = 9.0 if batch_mode else 7.0
     print(f"recovery: p50 {p50:.2f}s p99 {p99:.2f}s "
           f"(bound {bound}s, n={len(rec)})", flush=True)
+
+    # span table: where the client-observed window actually goes
+    def pctl(xs, q):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(len(xs) * q))] if xs else None
+
+    elect = [d["elect_s"] for d in decomp]
+    writable = [d["writable_s"] for d in decomp
+                if d["writable_s"] is not None]
+    client = [d["client_s"] for d in decomp
+              if d["client_s"] is not None]
+    probe_art = [d["client_s"] - d["writable_s"] for d in decomp
+                 if d["client_s"] is not None
+                 and d["writable_s"] is not None]
+    print("kill->writable decomposition (re-elected lanes, "
+          f"n={len(decomp)}):", flush=True)
+    for label, xs in [("election won", elect),
+                      ("server writable (first apply)", writable),
+                      ("client-observed ack", client),
+                      ("probe artifact (client - server)", probe_art)]:
+        if xs:
+            print(f"  {label:34s} p50 {pctl(xs, 0.5):6.2f}s  "
+                  f"p99 {pctl(xs, 0.99):6.2f}s", flush=True)
+    if unaffected:
+        print(f"  {'unaffected-lane client ack':34s} "
+              f"p50 {pctl(unaffected, 0.5):6.2f}s  "
+              f"p99 {pctl(unaffected, 0.99):6.2f}s "
+              f"(n={len(unaffected)}; pure probe baseline)",
+              flush=True)
+    print(json.dumps({"recovery_decomp": decomp,
+                      "unaffected": [round(x, 3)
+                                     for x in unaffected]}),
+          flush=True)
     assert p99 < bound, f"p99 leader recovery {p99:.2f}s >= {bound}s"
+    # The round-3 liveness criterion, asserted on the metric it was
+    # actually about: the SERVER-side kill->writable window (the
+    # client-observed number additionally pays the drill's
+    # sequential 3s-timeout probe resolution, measured above as the
+    # probe artifact).  Worst-case election timeout is 2s (see
+    # bound comment); 2x = 4s (+1s contention slack in batch mode:
+    # 4 processes + pipelined client on one core).
+    assert decomp_fetch_failures <= CYCLES // 4, \
+        f"/mraft/leaders fetch failed on {decomp_fetch_failures}/" \
+        f"{CYCLES} cycles — decomposition has no coverage"
+    if writable and len(writable) >= 6:
+        wr99 = pctl(writable, 0.99)
+        wbound = 5.0 if batch_mode else 4.0
+        print(f"server-writable p99 {wr99:.2f}s "
+              f"(bound {wbound}s)", flush=True)
+        assert wr99 < wbound, \
+            f"p99 server kill->writable {wr99:.2f}s >= {wbound}s"
     print(f"CHAOS DRILL CLEAN: {CYCLES} kill/restart cycles, "
           f"{seq} writes, zero acked writes lost", flush=True)
 finally:

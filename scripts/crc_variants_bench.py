@@ -55,27 +55,49 @@ def main() -> None:
     backend = jax.default_backend()
 
     # synthetic right-aligned chained records (seed-injected, so every
-    # variant's gate is the full rolling-chain verify)
+    # variant's gate is the full rolling-chain verify).  Generation is
+    # vectorized — a python-loop crc32c.update over N rows costs tens
+    # of minutes of a live tunnel session at N=1M: raw CRCs come from
+    # one batched contraction, the rolling chain from a GF(2) matvec
+    # scan (~23 us/row), and an INDEPENDENT host-table CRC spot check
+    # over 256 random rows guards against the generator and the
+    # device-under-test sharing a bug.
+    from etcd_tpu.crc import gf2
+
+    c = jnp.asarray(contribution_matrix(width))
+    t_gen = time.perf_counter()
     rng = np.random.default_rng(3)
     lens = rng.integers(width // 2, width - 4, size=n)
-    rows = np.zeros((n, width), np.uint8)
+    fill = rng.integers(0, 256, size=(n, width), dtype=np.uint8)
+    mask = np.arange(width)[None, :] >= (width - lens)[:, None]
+    rows = np.where(mask, fill, 0).astype(np.uint8)
+    del fill, mask
+    raw = np.asarray(_raw_crc_jit(rows, c, use_pallas=False))
+    zmats = {int(ln): gf2.zero_operator(int(ln))
+             for ln in np.unique(lens)}
     stored = np.empty(n, np.uint32)
     prev_ = np.empty(n, np.uint32)
     chain = 0
-    # vectorized-ish generation: fill then fix chains in one pass
-    fill = rng.integers(0, 256, size=(n, width), dtype=np.uint8)
+    inv = 0xFFFFFFFF
     for i in range(n):
-        li = int(lens[i])
-        rows[i, width - li:] = fill[i, :li]
         prev_[i] = chain
-        chain = crc32c.update(chain, rows[i, width - li:].tobytes())
+        chain = (gf2.matvec(zmats[int(lens[i])], chain ^ inv)
+                 ^ int(raw[i]) ^ inv)
         stored[i] = chain
+    # independent gate on the generator itself: host table CRC
+    for i in rng.choice(n, size=min(n, 256), replace=False):
+        li = int(lens[i])
+        want = crc32c.update(int(prev_[i]),
+                             rows[i, width - li:].tobytes())
+        assert want == int(stored[i]), f"generator mismatch at {i}"
     inject_seeds(rows, lens, prev_)
+    print(json.dumps({"generated": n,
+                      "seconds": round(time.perf_counter() - t_gen,
+                                       1)}), flush=True)
 
     drows = jax.device_put(rows)
     dstored = jax.device_put(stored)
 
-    c = jnp.asarray(contribution_matrix(width))
     ck = jnp.asarray(plane_matrices(width))
 
     def make_fn(name):
@@ -85,16 +107,45 @@ def main() -> None:
             return lambda b: _raw_crc_jit(b, c, use_pallas=True)
         from etcd_tpu.ops import crc_variants
 
+        if "@" in name:  # tile-size sweep entries, e.g. pallas_planes@2048
+            base, tile = name.split("@")
+            tile = int(tile)
+            if base == "pallas_planes":
+                return lambda b: crc_variants._pallas_planes_jit(
+                    b, ck, tile, False, False)
+            if base == "pallas_planes_t":
+                return lambda b: crc_variants._pallas_planes_jit(
+                    b, ck, tile, True, False)
+            raise ValueError(name)
         jit_map = {"planes": lambda b: crc_variants._planes_jit(b, ck),
                    "transposed":
                    lambda b: crc_variants._transposed_jit(b, c),
                    "planes_t":
-                   lambda b: crc_variants._planes_t_jit(b, ck)}
+                   lambda b: crc_variants._planes_t_jit(b, ck),
+                   "int4": lambda b: crc_variants._int4_jit(b, c),
+                   "planes4":
+                   lambda b: crc_variants._planes4_jit(b, ck),
+                   "pallas_planes": crc_variants.raw_crc_pallas_planes,
+                   "pallas_planes_t":
+                   crc_variants.raw_crc_pallas_planes_t}
         return jit_map[name]
 
+    from etcd_tpu.ops import crc_variants as _cv
+
+    # every registered variant races (future VARIANTS additions are
+    # picked up automatically); on TPU the pallas_planes pair is
+    # covered by its explicit tile sweep instead of the default tile
     names = ["xla"] + sorted(VARIANTS)
     if backend == "tpu":
         names.insert(1, "pallas")
+        # hardware-only candidates (int4 CPU emulation compiles for
+        # minutes) and the pallas tile sweep
+        names = [x for x in names
+                 if x not in ("pallas_planes", "pallas_planes_t")]
+        names += sorted(_cv.TPU_RACE_VARIANTS)
+        names += ["pallas_planes@512", "pallas_planes@1024",
+                  "pallas_planes@2048",
+                  "pallas_planes_t@1024", "pallas_planes_t@2048"]
 
     results = {}
     for name in names:

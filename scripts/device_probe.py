@@ -1,0 +1,63 @@
+"""Bounded device-init probe: forks a child that initializes the jax
+backend and prints the device list; the parent gives it a deadline.
+
+The TCP-level relay_probe.py can print ``up`` while the tunnel is
+wedged (PALLAS_NOTES.md "Operational hazard": a stuck session makes
+every subsequent ``jax.devices()`` hang in ANY process).  This probe
+answers the question that matters before committing chip time: can a
+fresh process actually establish a session right now?
+
+    python scripts/device_probe.py [TIMEOUT_S]     (default 120)
+
+Prints one JSON line {"outcome": "ok"|"hang"|"error", ...}; exit 0
+only on "ok".
+"""
+
+import json
+import multiprocessing as mp
+import sys
+import time
+
+
+def _probe(q):
+    try:
+        import jax
+
+        q.put(("ok", ",".join(str(d) for d in jax.devices()),
+               jax.default_backend()))
+    except Exception as e:  # pragma: no cover - env specific
+        q.put(("error", repr(e)[:200], None))
+
+
+def main() -> int:
+    budget = float(sys.argv[1]) if len(sys.argv) > 1 else 120.0
+    mp.set_start_method("spawn")
+    q = mp.Queue()
+    p = mp.Process(target=_probe, args=(q,), daemon=True)
+    t0 = time.time()
+    p.start()
+    p.join(timeout=budget)
+    if p.is_alive():
+        p.terminate()
+        p.join(5)
+        if p.is_alive():
+            # a child stuck in uninterruptible native init survives
+            # SIGTERM; it must not outlive the probe holding (or
+            # queueing for) the single-session tunnel
+            p.kill()
+            p.join(5)
+        print(json.dumps({"outcome": "hang", "budget_s": budget}))
+        return 1
+    if q.empty():
+        print(json.dumps({"outcome": "error",
+                          "detail": "child died silently"}))
+        return 1
+    kind, detail, backend = q.get()
+    print(json.dumps({"outcome": kind, "devices": detail,
+                      "backend": backend,
+                      "seconds": round(time.time() - t0, 1)}))
+    return 0 if kind == "ok" and backend == "tpu" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

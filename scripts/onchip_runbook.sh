@@ -1,0 +1,39 @@
+#!/bin/bash
+# On-chip runbook (ROUND4_NOTES / VERDICT r4 #1): executed the moment
+# a device-init probe succeeds.  Single-flight: each stage is one
+# process using the tunnel; stages run strictly in sequence.
+#
+#   1. race every raw-CRC kernel variant at the bench shape
+#   2. promote the winner via BENCH_CRC_VARIANT
+#   3. full bench.py -> driver-grade session artifact
+#
+# Usage: scripts/onchip_runbook.sh [OUTDIR]   (default bench_artifacts)
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-bench_artifacts}
+STAMP=$(date -u +%Y%m%dT%H%M%SZ)
+
+echo "[runbook $STAMP] variants race" >&2
+timeout 1800 python scripts/crc_variants_bench.py 1048576 384 8 \
+    2>&1 | tee "$OUT/session_race_$STAMP.log"
+
+BEST=$(grep '"best"' "$OUT/session_race_$STAMP.log" | tail -1 |
+    python -c 'import json,sys
+line = sys.stdin.readline()
+try:
+    print(json.loads(line)["best"])
+except Exception:
+    print("")')
+if [ -z "$BEST" ]; then
+    echo "[runbook] race produced no winner; defaulting to pallas" >&2
+    BEST=pallas
+fi
+echo "[runbook] winning variant: $BEST" >&2
+
+echo "[runbook $STAMP] full bench with BENCH_CRC_VARIANT=$BEST" >&2
+BENCH_CRC_VARIANT=$BEST timeout 3000 python bench.py \
+    > "$OUT/session_bench_$STAMP.json" \
+    2> "$OUT/session_bench_$STAMP.log"
+rc=$?
+tail -1 "$OUT/session_bench_$STAMP.json" >&2
+echo "[runbook $STAMP] done rc=$rc best=$BEST" >&2

@@ -57,3 +57,20 @@ def test_variant_composes_with_seed_injection(name):
     inject_seeds(rows, lens, prev)
     ok = chain_links_injected(VARIANTS[name](rows), stored)
     assert np.asarray(ok).all()
+
+
+@pytest.mark.parametrize("name", ["pallas_planes", "pallas_planes_t"])
+def test_perturbed_kernel_matches_outer_xor(name):
+    """The SMEM perturb operand (bench.py's sustained-loop LICM
+    defeat) must compute exactly raw(buf ^ uint8(i)) — the headline
+    TPU number depends on it, and the bench gate only checks i=0."""
+    from etcd_tpu.ops.crc_variants import pallas_planes_perturbed
+
+    rng = np.random.default_rng(11)
+    rows = rng.integers(0, 256, size=(70, 132), dtype=np.uint8)
+    fn = pallas_planes_perturbed(name)
+    for i in (0, 3, 255):
+        want = np.asarray(raw_crc_batch(rows ^ np.uint8(i),
+                                        use_pallas=False))
+        got = np.asarray(fn(rows, i))
+        np.testing.assert_array_equal(got, want, err_msg=f"i={i}")

@@ -701,3 +701,42 @@ def test_need_snap_lanes_never_persist_phantom_entries(tmp_path):
                     g=g, cap=64, tick_interval=0.05)
     assert (s2.mr.terms() == 5).all()
     s2.wal.close()
+
+
+def test_leaders_endpoint_traces_elections(cluster):
+    """GET /mraft/leaders: the leadership-transition trace the chaos
+    drill's kill->writable decomposition reads (VERDICT r4 #3).
+    Bootstrap elections and the first post-election apply must be
+    stamped with wall times; a host that leads nothing reports its
+    (empty) trace without error."""
+    import json as _json
+    import urllib.request
+
+    servers, ports, _ = cluster
+    put(servers[0], "/lt/k", "v")  # ensure a post-election apply
+
+    def fetch(slot):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{ports[slot]}/mraft/leaders",
+                timeout=10) as r:
+            return _json.loads(r.read())
+
+    # elections can flap under CPU load — poll for the settled view
+    # rather than asserting a snapshot (same discipline as the other
+    # tests in this file)
+    wait_for(lambda: all(fetch(0)["lead"]),
+             msg="slot 0 leads every lane")
+    d0 = fetch(0)
+    assert d0["slot"] == 0
+    now = time.time()
+    assert all(0 < e <= now for e in d0["elected_at"])
+    assert all(t >= 1 for t in d0["elected_term"])
+    wait_for(lambda: any(f > 0 for f in fetch(0)["first_apply_at"]),
+             msg="first post-election apply stamped")
+    d0 = fetch(0)
+    for e, f in zip(d0["elected_at"], d0["first_apply_at"]):
+        if f:
+            assert f >= e, "apply cannot precede the election win"
+    # while slot 0 holds every lane, peers lead nothing and say so
+    if all(fetch(0)["lead"]):
+        assert not any(fetch(1)["lead"])
