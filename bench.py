@@ -849,14 +849,10 @@ def _make_raw_fn():
             b, use_pallas=(v == "pallas"))), v, None
     from etcd_tpu.ops import crc_variants
 
-    if v.startswith("pallas_planes"):
+    base, tile = crc_variants.parse_variant(v)  # loud on typos
+    if base.startswith("pallas_planes"):
         # the planes pallas kernels take the LICM-defeating perturb
         # scalar in SMEM — no per-iteration HBM copy of the batch
-        base, _, tile = v.partition("@")
-        if base not in ("pallas_planes", "pallas_planes_t") or (
-                tile and not tile.isdigit()):
-            raise ValueError(f"unknown BENCH_CRC_VARIANT {v!r}")
-        tile = int(tile) if tile else None
         fn = (crc_variants.raw_crc_pallas_planes_t
               if base.endswith("_t")
               else crc_variants.raw_crc_pallas_planes)
@@ -864,68 +860,63 @@ def _make_raw_fn():
                 crc_variants.pallas_planes_perturbed(base, tile))
     table = dict(crc_variants.VARIANTS,
                  **crc_variants.TPU_RACE_VARIANTS)
-    if v not in table:
-        raise ValueError(f"unknown BENCH_CRC_VARIANT {v!r}")
-    return table[v], v, None
+    return table[base], v, None
 
 
-def probe_env_ceiling(jax) -> dict | None:
-    """Measured dense matmul throughput of this harness's device:
-    ``{"bf16": TFLOPS, "int8": TOPS}``.
+def probe_env_ceiling(jax, dtype_name: str = "bf16") -> float | None:
+    """Measured dense 2048^3 matmul throughput of this harness's
+    device: TFLOPS for ``bf16``, TOPS for ``int8``.
 
     Context for the primary metric: the axon-tunnel chip measures a
     small fraction of the v5e spec (~197 bf16 TFLOPS / ~394 int8
-    TOPS) on dense 2048^3 matmuls, and that measured ceiling caps
-    every MXU-based number in this file — it is recorded in the JSON
-    so the replay number can be read against the hardware actually
-    behind the tunnel.  Both probes run 64-deep device-resident
-    trains with one scalar fetch: earlier 16-deep trains (~83 ms
-    total at the observed rates) were still dominated by the
-    tunnel's fixed per-dispatch latency, which is how round-4's
-    artifact printed an impossible 408%-of-ceiling MFU.  The int8
-    row exists because the CRC contraction IS an int8 matmul — it is
-    the honest denominator for that kernel's MFU.
+    TOPS), and that measured ceiling caps every MXU-based number in
+    this file — it is recorded in the JSON so the replay number can
+    be read against the hardware actually behind the tunnel.  The
+    probe runs a 64-deep device-resident train with one scalar
+    fetch: earlier 16-deep trains (~83 ms total at the observed
+    rates) were still dominated by the tunnel's fixed per-dispatch
+    latency, which is how round-4's artifact printed an impossible
+    408%-of-ceiling MFU.  The int8 row exists because the CRC
+    contraction IS an int8 matmul — the honest denominator for that
+    kernel's MFU.  One dtype per call so the caller can give each
+    probe its own stall budget (a hang in the second must not
+    discard the first's measurement).
     """
     import functools
 
     import jax.numpy as jnp
 
-    out = {}
     k = 64
     rng = np.random.default_rng(3)
+    dtype = jnp.bfloat16 if dtype_name == "bf16" else jnp.int8
 
-    def train(a, b, dtype):
-        @functools.partial(jax.jit, static_argnames=("k",))
-        def loop(a, b, k):
-            def body(i, acc):
-                r = jax.lax.dot_general(
-                    a + i.astype(dtype), b,
-                    dimension_numbers=(((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32
-                    if dtype == jnp.bfloat16 else jnp.int32)
-                return acc + r[0, 0].astype(jnp.float32)
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def loop(a, b, k):
+        def body(i, acc):
+            r = jax.lax.dot_general(
+                a + i.astype(dtype), b,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32
+                if dtype == jnp.bfloat16 else jnp.int32)
+            return acc + r[0, 0].astype(jnp.float32)
 
-            return jax.lax.fori_loop(0, k, body, jnp.float32(0))
+        return jax.lax.fori_loop(0, k, body, jnp.float32(0))
 
-        float(loop(a, b, k))  # compile (same static k as timed call)
+    try:
+        if dtype_name == "bf16":
+            a = jax.device_put(rng.standard_normal(
+                (2048, 2048)).astype(jnp.bfloat16))
+        else:
+            a = jax.device_put(rng.integers(
+                -4, 4, size=(2048, 2048)).astype(np.int8))
+        float(loop(a, a, k))  # compile (same static k as timed call)
         t0 = time.perf_counter()
-        float(loop(a, b, k))
+        float(loop(a, a, k))
         dt = time.perf_counter() - t0
         return 2 * 2048**3 * k / dt / 1e12
-
-    try:
-        a = jax.device_put(
-            rng.standard_normal((2048, 2048)).astype(jnp.bfloat16))
-        out["bf16"] = train(a, a, jnp.bfloat16)
     except Exception as e:  # pragma: no cover - device-env specific
-        log(f"env ceiling probe (bf16) failed: {e!r}")
-    try:
-        ai = jax.device_put(rng.integers(
-            -4, 4, size=(2048, 2048)).astype(np.int8))
-        out["int8"] = train(ai, ai, jnp.int8)
-    except Exception as e:  # pragma: no cover - device-env specific
-        log(f"env ceiling probe (int8) failed: {e!r}")
-    return out or None
+        log(f"env ceiling probe ({dtype_name}) failed: {e!r}")
+        return None
 
 
 def start_deadline_watchdog():
@@ -1129,30 +1120,39 @@ def main():
         # small ceiling probe, so a mid-run kill or tunnel wedge cannot
         # take it down with the (longer, tunnel-bound) e2e stage.
         if not degraded:
-            st, ceil = bounded("env ceiling probe",
-                               lambda: probe_env_ceiling(jax),
-                               _stage_budget(DEVICE_TIMEOUT // 2))
+            # one bounded stage per dtype: an int8-side stall must
+            # not discard the already-measured bf16 ceiling (a stall
+            # still flips device_ok — a wedged device would hang the
+            # sustained stage too)
+            st, tflops = bounded(
+                "env ceiling probe (bf16)",
+                lambda: probe_env_ceiling(jax, "bf16"),
+                _stage_budget(DEVICE_TIMEOUT // 2))
             if st == "stalled":
                 device_ok = False
                 extra["env_ceiling"] = "stalled"
                 checkpoint("env_ceiling", {"outcome": "stalled"})
-            elif st == "ok" and ceil:
-                tflops = ceil.get("bf16")
-                if tflops:
-                    log(f"env dense-matmul ceiling: {tflops:.2f} "
-                        f"TFLOPS bf16 (v5e spec ~197)")
-                    extra["env_matmul_tflops_bf16"] = round(tflops, 2)
-                    extra["v5e_spec_tflops_bf16"] = 197
-                tops8 = ceil.get("int8")
-                if tops8:
+            elif st == "ok" and tflops:
+                log(f"env dense-matmul ceiling: {tflops:.2f} "
+                    f"TFLOPS bf16 (v5e spec ~197)")
+                extra["env_matmul_tflops_bf16"] = round(tflops, 2)
+                extra["v5e_spec_tflops_bf16"] = 197
+            if device_ok:
+                st8, tops8 = bounded(
+                    "env ceiling probe (int8)",
+                    lambda: probe_env_ceiling(jax, "int8"),
+                    _stage_budget(DEVICE_TIMEOUT // 2))
+                if st8 == "stalled":
+                    device_ok = False
+                    extra["env_ceiling"] = "stalled (int8)"
+                elif st8 == "ok" and tops8:
                     log(f"env dense-matmul ceiling: {tops8:.2f} "
                         f"TOPS int8 (v5e spec ~394)")
                     extra["env_matmul_tops_int8"] = round(tops8, 2)
                     extra["v5e_spec_tops_int8"] = 394
-                checkpoint("env_ceiling", {
-                    "tflops_bf16": round(tflops, 2) if tflops
-                    else None,
-                    "tops_int8": round(tops8, 2) if tops8 else None})
+            checkpoint("env_ceiling", {
+                "tflops_bf16": extra.get("env_matmul_tflops_bf16"),
+                "tops_int8": extra.get("env_matmul_tops_int8")})
 
         sustain_iters = SUSTAIN_ITERS or (
             32 if backend == "tpu" else 8)

@@ -316,6 +316,26 @@ TPU_RACE_VARIANTS = {
 }
 
 
+def parse_variant(name: str) -> tuple[str, int | None]:
+    """Validate and split a variant name of the ``base`` or
+    ``base@tile`` grammar shared by BENCH_CRC_VARIANT (bench.py) and
+    the race script.  Returns (base, tile-or-None); raises
+    ValueError on an unknown base or a non-numeric tile — a typo
+    must fail loudly, not run some other kernel under the wrong
+    label in a bench artifact."""
+    base, _, tile = name.partition("@")
+    known = ({"xla", "pallas"} | set(VARIANTS)
+             | set(TPU_RACE_VARIANTS))
+    if base not in known:
+        raise ValueError(f"unknown CRC variant {name!r}")
+    if tile and not tile.isdigit():
+        raise ValueError(f"non-numeric tile in variant {name!r}")
+    if tile and not base.startswith("pallas_planes"):
+        raise ValueError(f"only pallas_planes kernels take @tile: "
+                         f"{name!r}")
+    return base, int(tile) if tile else None
+
+
 def pallas_planes_perturbed(name: str = "pallas_planes",
                             tile: int | None = None):
     """``(buf, i) -> raw CRCs of buf ^ uint8(i)`` with the

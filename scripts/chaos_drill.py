@@ -28,6 +28,7 @@ import shutil
 import signal
 import subprocess
 import sys
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -122,11 +123,28 @@ shutil.rmtree(BASE, ignore_errors=True)  # stale dirs from a prior
 os.makedirs(BASE, exist_ok=True)
 procs = {i: start(i) for i in range(3)}
 time.sleep(22)
+# settle gate: cycle 0 must start from a serving cluster, not one
+# still jit-compiling its round programs (observed: a cold start
+# under load left every group leaderless for the whole first
+# window).  Require one acked write per drill key before any kill.
+settle_deadline = time.time() + 60
+for key in KEYS:
+    while True:
+        try:
+            put(CLIENT[0], key, "warmup", timeout=3)
+            break
+        except Exception:
+            if time.time() > settle_deadline:
+                raise RuntimeError("cluster failed to settle in 60s")
+            time.sleep(0.5)
+print("cluster settled: all groups serving", flush=True)
 
 rng = random.Random(2026)
 acked = {}    # key -> last acked value
 issued = {}   # key -> set of ALL issued values (acked or timed out:
               # a timed-out PUT may commit late — at-least-once)
+for key in KEYS:  # the settle gate's warmup writes are issued values
+    issued.setdefault(key, set()).add("warmup")
 seq = 0
 lost = []
 recovery = []  # per-cycle: seconds from kill to all-groups-writable
@@ -136,7 +154,7 @@ unaffected = []  # client-ack delay for groups that kept their leader
 decomp_fetch_failures = 0  # cycles whose /mraft/leaders fetch failed
 
 
-def fetch_leaders(slots):
+def fetch_leaders(slots, timeout=5):
     """GET /mraft/leaders from each slot: the server-side
     leadership-transition trace (election wall time + first
     post-election apply per group)."""
@@ -144,11 +162,33 @@ def fetch_leaders(slots):
     for s in slots:
         try:
             with urllib.request.urlopen(PEERS[s] + "/mraft/leaders",
-                                        timeout=5) as r:
+                                        timeout=timeout) as r:
                 out[s] = json.loads(r.read())
         except Exception:
             pass
     return out
+
+
+def merge_trace(obs, leaders, t_kill):
+    """Fold a /mraft/leaders snapshot into ``obs``: per
+    (slot, group, term) the election wall time and first apply.
+
+    The server keeps only the LATEST win per lane, so a leadership
+    flap later in the window would overwrite the election that
+    actually restored service (observed: a correlated 4-lane re-
+    election at +7.6s on a lane serving clients from +1.4s).
+    Sampling during the window and merging by term preserves the
+    early wins; a sample that arrives before the lane's first apply
+    is upgraded when a later sample carries the apply stamp."""
+    for s, d in leaders.items():
+        for g in range(N_GROUPS):
+            if d["elected_at"][g] <= t_kill:
+                continue
+            k3 = (s, g, d["elected_term"][g])
+            fa = d["first_apply_at"][g]
+            prev = obs.get(k3)
+            if prev is None or (prev[1] == 0 and fa > 0):
+                obs[k3] = (d["elected_at"][g], fa)
 
 try:
     for cycle in range(CYCLES):
@@ -172,6 +212,23 @@ try:
         ok = fail = 0
         # liveness probe state: first post-kill ack time per group
         group_up = {}
+        # leadership-trace samples merged through the window (the
+        # server keeps only the latest win per lane; see merge_trace)
+        # from a BACKGROUND thread: an inline fetch would stall the
+        # write probes for up to its timeout and inflate the
+        # client-observed recovery the drill asserts on
+        trace_obs = {}
+        stop_trace = threading.Event()
+
+        def trace_sampler():
+            while not stop_trace.is_set():
+                l = fetch_leaders(survivors, timeout=2)
+                merge_trace(trace_obs, l, t_kill)
+                stop_trace.wait(0.7)
+
+        sampler_thread = threading.Thread(target=trace_sampler,
+                                          daemon=True)
+        sampler_thread.start()
         while time.time() < t_end:
             if batch_mode:
                 items = []
@@ -222,6 +279,8 @@ try:
         # survivor wins the lane's election), server-writable delay
         # (kill -> first post-election apply), and the remainder
         # (the drill's own sequential 3s-timeout probe resolution)
+        stop_trace.set()
+        sampler_thread.join(5)
         leaders = fetch_leaders(survivors)
         partial = len(leaders) < len(survivors)
         if partial:
@@ -236,21 +295,26 @@ try:
                   f" survivors (decomposition "
                   f"{'partial' if leaders else 'skipped'})",
                   flush=True)
-        for g in range(N_GROUPS) if leaders else []:
-            best = None
-            for s, d in leaders.items():
-                if d["elected_at"][g] > t_kill and (
-                        best is None
-                        or d["elected_term"][g] > best[0]):
-                    best = (d["elected_term"][g], d["elected_at"][g],
-                            d["first_apply_at"][g])
+        merge_trace(trace_obs, leaders, t_kill)
+        # mid-window samples are evidence even when the final fetch
+        # came back empty — only a cycle with NO observations at all
+        # is skipped
+        for g in range(N_GROUPS) if (leaders or trace_obs) else []:
+            # FIRST post-kill election / apply across all observed
+            # wins restores the kill->writable meaning under flaps:
+            # later re-elections on an already-serving lane must not
+            # re-attribute its recovery
+            ents = [v for (s_, g_, t_), v in trace_obs.items()
+                    if g_ == g]
             cs = group_up[g] - t_kill if g in group_up else None
-            if best is not None:
+            if ents:
+                elect = min(e for e, _ in ents)
+                applies = [f for _, f in ents if f > 0]
                 decomp.append({
                     "cycle": cycle, "group": g,
-                    "elect_s": round(best[1] - t_kill, 3),
-                    "writable_s": round(best[2] - t_kill, 3)
-                    if best[2] > 0 else None,
+                    "elect_s": round(elect - t_kill, 3),
+                    "writable_s": round(min(applies) - t_kill, 3)
+                    if applies else None,
                     "client_s": round(cs, 3)
                     if cs is not None else None})
             elif cs is not None and not partial:
@@ -279,13 +343,24 @@ try:
         # catch-up = replica EQUALITY with a survivor (the acked map
         # can be stale: late requeued commits overwrite it)
         caught = False
+
+        def view(base):
+            # replica equality must tolerate keys that never
+            # committed (every issued write for a group can be
+            # rejected in a bad window): absent-on-both is equal,
+            # absent-on-one is divergence — an HTTPError must not
+            # abort the whole comparison
+            out = {}
+            for k in issued:
+                try:
+                    out[k] = get(base, k)["node"]["value"]
+                except urllib.error.HTTPError:
+                    out[k] = None
+            return out
+
         for _ in range(60):
             try:
-                ref = {k: get(CLIENT[survivors[0]], k)
-                       ["node"]["value"] for k in issued}
-                mine = {k: get(CLIENT[victim], k)["node"]["value"]
-                        for k in issued}
-                if ref == mine:
+                if view(CLIENT[survivors[0]]) == view(CLIENT[victim]):
                     caught = True
                     break
             except Exception:

@@ -107,16 +107,15 @@ def main() -> None:
             return lambda b: _raw_crc_jit(b, c, use_pallas=True)
         from etcd_tpu.ops import crc_variants
 
-        if "@" in name:  # tile-size sweep entries, e.g. pallas_planes@2048
-            base, tile = name.split("@")
-            tile = int(tile)
-            if base == "pallas_planes":
-                return lambda b: crc_variants._pallas_planes_jit(
-                    b, ck, tile, False, False)
-            if base == "pallas_planes_t":
-                return lambda b: crc_variants._pallas_planes_jit(
-                    b, ck, tile, True, False)
-            raise ValueError(name)
+        # same name grammar as BENCH_CRC_VARIANT (one validator: a
+        # name the race promotes must be one the bench accepts)
+        base, tile = crc_variants.parse_variant(name)
+        if base.startswith("pallas_planes"):
+            t = tile or crc_variants.PLANES_TILE
+            transposed = base.endswith("_t")
+            interp = backend != "tpu"
+            return lambda b: crc_variants._pallas_planes_jit(
+                b, ck, t, transposed, interp)
         jit_map = {"planes": lambda b: crc_variants._planes_jit(b, ck),
                    "transposed":
                    lambda b: crc_variants._transposed_jit(b, c),
@@ -124,11 +123,8 @@ def main() -> None:
                    lambda b: crc_variants._planes_t_jit(b, ck),
                    "int4": lambda b: crc_variants._int4_jit(b, c),
                    "planes4":
-                   lambda b: crc_variants._planes4_jit(b, ck),
-                   "pallas_planes": crc_variants.raw_crc_pallas_planes,
-                   "pallas_planes_t":
-                   crc_variants.raw_crc_pallas_planes_t}
-        return jit_map[name]
+                   lambda b: crc_variants._planes4_jit(b, ck)}
+        return jit_map[base]
 
     from etcd_tpu.ops import crc_variants as _cv
 

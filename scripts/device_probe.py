@@ -48,11 +48,15 @@ def main() -> int:
             p.join(5)
         print(json.dumps({"outcome": "hang", "budget_s": budget}))
         return 1
-    if q.empty():
+    try:
+        # q.empty() right after join() races the queue's feeder
+        # thread — a healthy probe could read as dead and the watcher
+        # would skip an open chip window; block briefly instead
+        kind, detail, backend = q.get(timeout=10)
+    except Exception:
         print(json.dumps({"outcome": "error",
                           "detail": "child died silently"}))
         return 1
-    kind, detail, backend = q.get()
     print(json.dumps({"outcome": kind, "devices": detail,
                       "backend": backend,
                       "seconds": round(time.time() - t0, 1)}))

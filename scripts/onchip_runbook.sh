@@ -37,3 +37,6 @@ BENCH_CRC_VARIANT=$BEST timeout 3000 python bench.py \
 rc=$?
 tail -1 "$OUT/session_bench_$STAMP.json" >&2
 echo "[runbook $STAMP] done rc=$rc best=$BEST" >&2
+# propagate the bench outcome: a watcher gating on this script's
+# status must see a timed-out/crashed bench as a failed window
+exit $rc

@@ -737,6 +737,12 @@ def test_leaders_endpoint_traces_elections(cluster):
     for e, f in zip(d0["elected_at"], d0["first_apply_at"]):
         if f:
             assert f >= e, "apply cannot precede the election win"
-    # while slot 0 holds every lane, peers lead nothing and say so
-    if all(fetch(0)["lead"]):
-        assert not any(fetch(1)["lead"])
+    # while slot 0 holds every lane, peers lead nothing and say so —
+    # guarded on BOTH sides of the peer fetch (a load-induced flap
+    # between the guard and the assert must invalidate the check,
+    # not fail it)
+    lead_before = all(fetch(0)["lead"])
+    d1 = fetch(1)
+    lead_after = all(fetch(0)["lead"])
+    if lead_before and lead_after:
+        assert not any(d1["lead"])
