@@ -1145,14 +1145,23 @@ def main():
                 if st8 == "stalled":
                     device_ok = False
                     extra["env_ceiling"] = "stalled (int8)"
+                    checkpoint("env_ceiling", {
+                        "outcome": "stalled (int8)",
+                        "tflops_bf16":
+                            extra.get("env_matmul_tflops_bf16")})
                 elif st8 == "ok" and tops8:
                     log(f"env dense-matmul ceiling: {tops8:.2f} "
                         f"TOPS int8 (v5e spec ~394)")
                     extra["env_matmul_tops_int8"] = round(tops8, 2)
                     extra["v5e_spec_tops_int8"] = 394
-            checkpoint("env_ceiling", {
-                "tflops_bf16": extra.get("env_matmul_tflops_bf16"),
-                "tops_int8": extra.get("env_matmul_tops_int8")})
+            if device_ok:
+                # data row only on a clean probe pass — a stall
+                # already wrote its outcome row, and a second row of
+                # nulls would mask it from latest-row readers
+                checkpoint("env_ceiling", {
+                    "tflops_bf16":
+                        extra.get("env_matmul_tflops_bf16"),
+                    "tops_int8": extra.get("env_matmul_tops_int8")})
 
         sustain_iters = SUSTAIN_ITERS or (
             32 if backend == "tpu" else 8)

@@ -111,7 +111,10 @@ def main() -> None:
         # name the race promotes must be one the bench accepts)
         base, tile = crc_variants.parse_variant(name)
         if base.startswith("pallas_planes"):
-            t = tile or crc_variants.PLANES_TILE
+            # same default-tile resolution as the bench wrappers
+            # (ETCD_CRC_TILE override included) — the promoted name
+            # must denote the same measured kernel in both
+            t = tile or crc_variants._planes_env_tile()
             transposed = base.endswith("_t")
             interp = backend != "tpu"
             return lambda b: crc_variants._pallas_planes_jit(
