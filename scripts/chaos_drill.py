@@ -123,32 +123,6 @@ shutil.rmtree(BASE, ignore_errors=True)  # stale dirs from a prior
 os.makedirs(BASE, exist_ok=True)
 procs = {i: start(i) for i in range(3)}
 time.sleep(22)
-# settle gate: cycle 0 must start from a serving cluster, not one
-# still jit-compiling its round programs (observed: a cold start
-# under load left every group leaderless for the whole first
-# window).  Require one acked write per drill key before any kill.
-settle_deadline = time.time() + 60
-try:
-    for key in KEYS:
-        while True:
-            try:
-                put(CLIENT[0], key, "warmup", timeout=3)
-                break
-            except Exception:
-                if time.time() > settle_deadline:
-                    raise RuntimeError(
-                        "cluster failed to settle in 60s")
-                time.sleep(0.5)
-except BaseException:
-    # this gate runs BEFORE the main try/finally — it must not
-    # orphan three server processes on the shared core
-    for p in procs.values():
-        try:
-            p.kill()
-        except Exception:
-            pass
-    raise
-print("cluster settled: all groups serving", flush=True)
 
 rng = random.Random(2026)
 acked = {}    # key -> last acked value
@@ -202,6 +176,25 @@ def merge_trace(obs, leaders, t_kill):
                 obs[k3] = (d["elected_at"][g], fa)
 
 try:
+    # settle gate: cycle 0 must start from a serving cluster, not
+    # one still jit-compiling its round programs (observed: a cold
+    # start under load left every group leaderless for the whole
+    # first window).  Require one acked write per drill key before
+    # any kill; inside the try so a never-settling cluster still
+    # hits the finally's kill loop.
+    settle_deadline = time.time() + 60
+    for key in KEYS:
+        while True:
+            try:
+                put(CLIENT[0], key, "warmup", timeout=3)
+                break
+            except Exception:
+                if time.time() > settle_deadline:
+                    raise RuntimeError(
+                        "cluster failed to settle in 60s")
+                time.sleep(0.5)
+    print("cluster settled: all groups serving", flush=True)
+
     for cycle in range(CYCLES):
         victim = rng.randrange(3)
         # writes against a surviving member while the victim is down
@@ -232,12 +225,17 @@ try:
         trace_lock = threading.Lock()
         stop_trace = threading.Event()
 
-        def trace_sampler():
-            while not stop_trace.is_set():
-                l = fetch_leaders(survivors, timeout=2)
-                with trace_lock:
-                    merge_trace(trace_obs, l, t_kill)
-                stop_trace.wait(0.7)
+        def trace_sampler(obs=trace_obs, lock=trace_lock,
+                          stop=stop_trace, tk=t_kill, sv=survivors):
+            # state bound at definition: a sampler surviving a
+            # timed-out join must keep operating on ITS cycle's
+            # dict/lock/event, not resurrect against the next
+            # cycle's rebound globals
+            while not stop.is_set():
+                l = fetch_leaders(sv, timeout=2)
+                with lock:
+                    merge_trace(obs, l, tk)
+                stop.wait(0.7)
 
         sampler_thread = threading.Thread(target=trace_sampler,
                                           daemon=True)

@@ -430,3 +430,55 @@ def test_randomized_lossy_exchange_log_matching(seed, m, steps):
                     pb = ms[b].committed_payload(gi, idx)
                     if pa is not None and pb is not None:
                         assert pa == pb, (gi, idx, pa, pb)
+
+
+def test_timeout_bands_are_disjoint_across_slots():
+    """Stratified election timeouts (distmember._draw_timeouts):
+    every draw a slot can make lives in a per-slot tick band that is
+    DISJOINT from every other slot's band, so two live hosts' timers
+    can never fire in the same band — the structural fix for the
+    drill's multi-round election tail (split votes between
+    survivors)."""
+    g, m, cap, election = 64, 3, 16, 10
+    ranges = []
+    for s in range(m):
+        mm = DistMember(g, m, s, cap, election=election, seed=s)
+        draws = np.concatenate(
+            [mm._draw_timeouts() for _ in range(50)])
+        assert (draws >= election).all()
+        assert (draws < 2 * election).all()
+        ranges.append((int(draws.min()), int(draws.max())))
+    for i in range(m):
+        for j in range(i + 1, m):
+            lo_i, hi_i = ranges[i]
+            lo_j, hi_j = ranges[j]
+            assert hi_i < lo_j or hi_j < lo_i, \
+                f"bands overlap: slot {i} {ranges[i]} vs " \
+                f"slot {j} {ranges[j]}"
+
+
+def test_lost_campaign_backs_off_beyond_band():
+    """Loser backoff (distmember.tally): a lane that campaigns and
+    LOSES must wait strictly longer than its normal band before
+    re-firing — an immediately re-firing refused candidate pre-empts
+    the better peer's campaign under slow frame delivery."""
+    g, m, cap, election = 8, 3, 16, 10
+    a = DistMember(g, m, 1, cap, election=election, seed=7)
+    mask = np.ones(g, bool)
+    a.begin_campaign(mask)
+    band_hi = election + 2 * max(1, election // m)  # slot 1 band end
+    # no responses at all -> every lane lost
+    won = a.tally(mask, [])
+    assert not won.any()
+    t = np.asarray(a.state.timeout)
+    assert (t >= band_hi).all(), \
+        f"lost lanes did not back off: timeouts {t}"
+    assert (t > election).all()
+    # a lane that WINS keeps its normal band on the next campaign
+    b = DistMember(g, 1, 0, cap, election=election, seed=8, live=1)
+    b.begin_campaign(np.ones(g, bool))
+    wonb = b.tally(np.ones(g, bool), [])  # single-member: self quorum
+    assert wonb.all()
+    tb = np.asarray(b.state.timeout)
+    w0 = max(1, election // 1)
+    assert (tb >= election).all() and (tb < election + w0).all()
