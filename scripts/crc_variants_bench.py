@@ -137,14 +137,15 @@ def main() -> None:
     names = ["xla"] + sorted(VARIANTS)
     if backend == "tpu":
         names.insert(1, "pallas")
-        # hardware-only candidates (int4 CPU emulation compiles for
-        # minutes) and the pallas tile sweep
+        # likely winners (the pallas tile sweep) race BEFORE the
+        # speculative int4 bets: an s4 lowering with a pathological
+        # compile time must not eat the window's race budget first
         names = [x for x in names
                  if x not in ("pallas_planes", "pallas_planes_t")]
-        names += sorted(_cv.TPU_RACE_VARIANTS)
         names += ["pallas_planes@512", "pallas_planes@1024",
                   "pallas_planes@2048",
                   "pallas_planes_t@1024", "pallas_planes_t@2048"]
+        names += sorted(_cv.TPU_RACE_VARIANTS)
 
     results = {}
     for name in names:

@@ -17,13 +17,24 @@ echo "[runbook $STAMP] variants race" >&2
 timeout 1800 python scripts/crc_variants_bench.py 1048576 384 8 \
     2>&1 | tee "$OUT/session_race_$STAMP.log"
 
-BEST=$(grep '"best"' "$OUT/session_race_$STAMP.log" | tail -1 |
-    python -c 'import json,sys
-line = sys.stdin.readline()
-try:
-    print(json.loads(line)["best"])
-except Exception:
-    print("")')
+# prefer the race's final summary; if the race was cut short (kill,
+# timeout), fall back to the fastest per-variant line it DID print
+BEST=$(python -c 'import json,sys
+best, rate = "", -1.0
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if not line.startswith("{"):
+        continue
+    try:
+        d = json.loads(line)
+    except Exception:
+        continue
+    if "best" in d:
+        best = d["best"]; break
+    if "variant" in d and "entries_per_sec" in d \
+            and d["entries_per_sec"] > rate:
+        best, rate = d["variant"], d["entries_per_sec"]
+print(best)' "$OUT/session_race_$STAMP.log")
 if [ -z "$BEST" ]; then
     echo "[runbook] race produced no winner; defaulting to pallas" >&2
     BEST=pallas
