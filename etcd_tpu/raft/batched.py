@@ -29,12 +29,33 @@ surface as per-group error lanes in the returned flags.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 
 from ..ops.quorum import commit_index_batch
+
+
+def _append_write_mode() -> str:
+    """``scatter`` | ``dense`` — how maybe_append writes the incoming
+    window (see the comment at its use site).  Read at trace time, so
+    the choice is baked into each compiled program; the env override
+    serves the parity tests and on-hardware races."""
+    import os
+
+    mode = os.environ.get("ETCD_APPEND_WRITE")
+    if mode in ("scatter", "dense"):
+        return mode
+    # default dense everywhere: the scatter form MEASURED 2x slower
+    # for the whole serving round on the XLA-CPU virtual mesh
+    # (config5 @100k groups: 89 -> 177 ms/round — XLA lowers the
+    # .at[].set to a non-aliased copy+scatter), and arithmetic says
+    # the dense [G, cap] write (~26 MB/exchange, ~2.6 ms at host
+    # bandwidth) was never the 23 ms/exchange bottleneck.  The knob
+    # and both forms stay for on-hardware racing.
+    return "dense"
 
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
 
@@ -128,9 +149,9 @@ def is_up_to_date(log_term, offset, last, cand_idx, cand_term):
     return (cand_term > lt) | ((cand_term == lt) & (cand_idx >= last))
 
 
-@jax.jit
 def maybe_append(state: GroupState, prev_idx, prev_term, ent_terms,
-                 n_ents, leader_commit, active=None):
+                 n_ents, leader_commit, active=None,
+                 write_mode: str | None = None):
     """Follower replication step, batched ``RaftLog.maybe_append``
     (log.go:49-69): term-match at prev, conflict scan, truncating
     append, commit advance.
@@ -138,7 +159,13 @@ def maybe_append(state: GroupState, prev_idx, prev_term, ent_terms,
     ``ent_terms`` [G, E] terms of incoming entries (entry j has index
     prev_idx + 1 + j), ``n_ents`` [G] how many are real, ``active``
     [G] bool mask of groups actually receiving an append (inactive
-    groups pass through unchanged).
+    groups pass through unchanged).  ``write_mode`` pins the window-
+    write form (scatter|dense); default resolves from
+    ETCD_APPEND_WRITE / the backend at call (or outer-trace) time —
+    the mode is a STATIC jit argument, so each form compiles its own
+    program and flipping the knob between calls takes effect (an
+    env read inside the traced body would be baked into the first
+    compile forever).
 
     Returns ``(state', ok, err_conflict, err_overflow)``:
     ``ok`` = the append was accepted (msgAppResp success);
@@ -148,6 +175,15 @@ def maybe_append(state: GroupState, prev_idx, prev_term, ent_terms,
     untouched and respond with a reject — one hot or corrupted group
     never poisons the batch.
     """
+    mode = write_mode or _append_write_mode()
+    return _maybe_append_jit(state, prev_idx, prev_term, ent_terms,
+                             n_ents, leader_commit, active,
+                             write_mode=mode)
+
+
+@partial(jax.jit, static_argnames=("write_mode",))
+def _maybe_append_jit(state, prev_idx, prev_term, ent_terms, n_ents,
+                      leader_commit, active, write_mode):
     g, cap = state.log_term.shape
     e = ent_terms.shape[1]
     if active is None:
@@ -171,15 +207,36 @@ def maybe_append(state: GroupState, prev_idx, prev_term, ent_terms,
     err_overflow = ok & (lastnewi - state.offset >= cap)
     ok = ok & ~(err_conflict | err_overflow)
 
-    # truncating append as one masked window write: slots in
-    # [prev_idx+1, lastnewi] take the incoming terms (identical values
-    # where already matching, new values from the conflict point on)
-    cap_idx = state.offset[:, None] + jnp.arange(cap, dtype=jnp.int32)
-    j = cap_idx - (prev_idx[:, None] + 1)
-    write = ok[:, None] & (j >= 0) & (j < n_ents[:, None])
-    incoming = jnp.take_along_axis(
-        ent_terms, jnp.clip(j, 0, e - 1), axis=1)
-    log_term = jnp.where(write, incoming, state.log_term)
+    # truncating append: slots in [prev_idx+1, lastnewi] take the
+    # incoming terms (identical values where already matching, new
+    # values from the conflict point on).  Two equivalent device
+    # forms (tests pin them to each other):
+    #
+    # - "scatter": write ONLY the E incoming slots.  E is 4-8 while
+    #   cap is 32-64, and the dense form's full [G, cap] read+write
+    #   per follower exchange was the serving round's dominant
+    #   memory traffic at 100k groups (round-5 profile).
+    # - "dense": one masked full-window where() — contiguous and
+    #   layout-friendly where gathers/scatters are expensive.
+    #
+    # Default: dense (measured faster end-to-end on the XLA-CPU
+    # virtual mesh — see _append_write_mode);
+    # ETCD_APPEND_WRITE={scatter,dense} overrides for racing.
+    if write_mode == "scatter":
+        rel = e_idx - state.offset[:, None]    # cap slot of entry j
+        writej = ok[:, None] & valid_e & (rel >= 0) & (rel < cap)
+        cols = jnp.where(writej, rel, cap)     # cap = dropped
+        gidx = jnp.arange(g, dtype=jnp.int32)[:, None]
+        log_term = state.log_term.at[gidx, cols].set(
+            ent_terms, mode="drop")
+    else:
+        cap_idx = state.offset[:, None] + \
+            jnp.arange(cap, dtype=jnp.int32)
+        j = cap_idx - (prev_idx[:, None] + 1)
+        write = ok[:, None] & (j >= 0) & (j < n_ents[:, None])
+        incoming = jnp.take_along_axis(
+            ent_terms, jnp.clip(j, 0, e - 1), axis=1)
+        log_term = jnp.where(write, incoming, state.log_term)
 
     last = jnp.where(ok & conflict, lastnewi, state.last)
     tocommit = jnp.minimum(leader_commit, lastnewi)

@@ -228,3 +228,35 @@ def test_grant_vote_up_to_date():
     # granted lanes recorded their vote
     np.testing.assert_array_equal(
         np.asarray(st2.vote)[grant], 1)
+
+
+def test_maybe_append_scatter_dense_equivalence():
+    """The two window-write forms (write_mode=scatter|dense) must
+    produce identical state — the knob exists for on-hardware
+    racing, never for semantics.  write_mode is a STATIC jit arg,
+    so each mode compiles (and runs) its own program — an env-only
+    knob read inside the traced body would make this test compare
+    the first-compiled program with itself."""
+    rng = np.random.default_rng(9)
+    for trial in range(4):
+        _, st = _mk_logs(rng)
+        prev_idx = rng.integers(0, 22, size=G).astype(np.int32)
+        prev_term = rng.integers(0, 5, size=G).astype(np.int32)
+        n_ents = rng.integers(0, E + 1, size=G).astype(np.int32)
+        ent_terms = np.sort(
+            rng.integers(1, 5, size=(G, E)).astype(np.int32), axis=1)
+        leader_commit = rng.integers(0, 30, size=G).astype(np.int32)
+        outs = {}
+        for mode in ("dense", "scatter"):
+            st2, ok, errc, erro = batched.maybe_append(
+                st, jnp.asarray(prev_idx), jnp.asarray(prev_term),
+                jnp.asarray(ent_terms), jnp.asarray(n_ents),
+                jnp.asarray(leader_commit), write_mode=mode)
+            outs[mode] = (np.asarray(st2.log_term),
+                          np.asarray(st2.last),
+                          np.asarray(st2.commit), np.asarray(ok),
+                          np.asarray(errc), np.asarray(erro))
+        # the scatter branch must actually write: at least one trial
+        # has accepted lanes with real entries
+        for a, b in zip(outs["dense"], outs["scatter"]):
+            np.testing.assert_array_equal(a, b, err_msg=str(trial))
