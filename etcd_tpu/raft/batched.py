@@ -46,7 +46,13 @@ def _append_write_mode() -> str:
     import os
 
     mode = os.environ.get("ETCD_APPEND_WRITE")
-    if mode in ("scatter", "dense"):
+    if mode:
+        if mode not in ("scatter", "dense"):
+            # a typo must fail loudly, not measure some other form
+            # under the wrong label (same convention as
+            # crc_variants.parse_variant)
+            raise ValueError(
+                f"ETCD_APPEND_WRITE={mode!r}: want scatter|dense")
         return mode
     # default dense everywhere: the scatter form MEASURED 2x slower
     # for the whole serving round on the XLA-CPU virtual mesh
