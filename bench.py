@@ -842,11 +842,25 @@ def _raced_winner() -> str:
     path = os.path.join(_ART_DIR, "crc_variant_winner.json")
     try:
         with open(path) as f:
-            v = json.load(f).get("variant", "")
+            rec = json.load(f)
+        v = rec.get("variant", "")
+        # staleness gate: the winner is only trusted within the same
+        # build round (the driver runs hours after the race, never
+        # days) — a committed record must not pin an old kernel
+        # choice after the kernels or the chip change
+        import calendar
+
+        stamp = time.strptime(rec["stamp"], "%Y%m%dT%H%M%SZ")
+        age_h = (time.time() - calendar.timegm(stamp)) / 3600.0
+        if not 0 <= age_h < 48:
+            log(f"ignoring {path}: stamp {rec['stamp']} is "
+                f"{age_h:.0f}h old")
+            return ""
         from etcd_tpu.ops.crc_variants import parse_variant
 
         parse_variant(v)  # validation only
-        log(f"sustained variant from raced winner file: {v}")
+        log(f"sustained variant from raced winner file: {v} "
+            f"(raced {age_h:.1f}h ago)")
         return v
     except FileNotFoundError:
         return ""

@@ -19,6 +19,14 @@ from ..utils.errors import (
 )
 from .event import NodeExtern
 
+
+def child_path(parent: str, name: str) -> str:
+    """``posixpath.join`` for the store's normalized shapes: parent
+    is a clean absolute path, name a single non-empty slash-free
+    segment (one definition — store and watcher both build child
+    paths on hot paths)."""
+    return ("/" + name) if parent == "/" else parent + "/" + name
+
 # Compare result explanations (node.go:12-17)
 COMPARE_MATCH = 0
 COMPARE_INDEX_NOT_MATCH = 1

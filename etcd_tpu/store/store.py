@@ -39,6 +39,7 @@ from .node_internal import (
     COMPARE_VALUE_NOT_MATCH,
     Node,
     PERMANENT,
+    child_path,
 )
 from .stats import (
     COMPARE_AND_DELETE_FAIL,
@@ -68,12 +69,26 @@ MIN_EXPIRE_TIME = 946684800.0  # 2000-01-01T00:00:00Z
 
 
 def clean_path(p: str) -> str:
+    # fast path: an already-clean absolute path (the overwhelmingly
+    # common case — client paths arrive cleaned once at the API
+    # layer, then every store op re-cleans defensively; normpath's
+    # python loop was ~20% of a Set in the reference-shape
+    # microbench).  Conditions exactly delimit inputs normpath would
+    # return unchanged: absolute, no empty/"."/".." segments, no
+    # trailing slash (except root itself).
+    if (p.startswith("/") and "//" not in p
+            and (p == "/" or not p.endswith("/"))
+            and "/./" not in p and "/../" not in p
+            and not p.endswith(("/.", "/.."))):
+        return p
     out = posixpath.normpath(posixpath.join("/", p))
     # Go's path.Clean collapses a leading double slash; POSIX normpath
     # preserves it
     if out.startswith("//"):
         out = out[1:]
     return out
+
+
 
 
 def _compare_fail_cause(n: Node, which: int, prev_value: str,
@@ -436,7 +451,7 @@ class Store:
             if child is not None:
                 return child
             raise EtcdError(ECODE_KEY_NOT_FOUND,
-                            posixpath.join(parent.path, name),
+                            child_path(parent.path, name),
                             self.current_index)
 
         return self._walk(node_path, walk_func)
@@ -448,7 +463,7 @@ class Store:
             if node.is_dir():
                 return node
             raise EtcdError(ECODE_NOT_DIR, node.path, self.current_index)
-        n = Node.new_dir(self, posixpath.join(parent.path, dir_name),
+        n = Node.new_dir(self, child_path(parent.path, dir_name),
                          self.current_index + 1, parent, parent.acl,
                          PERMANENT)
         parent.children[dir_name] = n

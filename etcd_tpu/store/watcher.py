@@ -15,6 +15,7 @@ import threading
 from ..utils.errors import EtcdError
 from .event import Event
 from .event_history import EventHistory
+from .node_internal import child_path
 
 _CLOSED = object()  # sentinel marking a closed event channel
 
@@ -136,7 +137,11 @@ class WatcherHub:
         segments = e.node.key.split("/")
         curr_path = "/"
         for segment in segments:
-            curr_path = posixpath.join(curr_path, segment)
+            # keys are clean absolute paths, so the only empty
+            # segment is the leading one (posixpath.join semantics
+            # for these shapes, without its per-call overhead)
+            if segment:
+                curr_path = child_path(curr_path, segment)
             self.notify_watchers(e, curr_path, False)
 
     def notify_watchers(self, e: Event, node_path: str,

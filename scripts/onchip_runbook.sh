@@ -35,23 +35,29 @@ for line in open(sys.argv[1]):
             and d["entries_per_sec"] > rate:
         best, rate = d["variant"], d["entries_per_sec"]
 print(best)' "$OUT/session_race_$STAMP.log")
-if [ -z "$BEST" ]; then
-    echo "[runbook] race produced no winner; defaulting to pallas" >&2
-    BEST=pallas
-fi
-echo "[runbook] winning variant: $BEST" >&2
-# persist the winner: a LATER bench run without BENCH_CRC_VARIANT in
-# its environment (the driver's end-of-round invocation) picks it up
-# via bench.py:_raced_winner — which reads the repo's canonical
-# bench_artifacts dir, so write there ALWAYS (not only to $OUT,
-# which may be a session-specific directory)
-python -c 'import json,sys
+if [ -n "$BEST" ]; then
+    # persist the MEASURED winner: a LATER bench run without
+    # BENCH_CRC_VARIANT in its environment (the driver's
+    # end-of-round invocation) picks it up via
+    # bench.py:_raced_winner — which reads the repo's canonical
+    # bench_artifacts dir, so write there ALWAYS (not only to $OUT,
+    # which may be a session-specific directory).  An empty BEST
+    # (race produced nothing) persists NOTHING: the fallback below
+    # is an unmeasured default and must not be recorded as a race
+    # result.
+    python -c 'import json,sys
 rec = {"variant": sys.argv[1], "stamp": sys.argv[2],
        "source": "onchip_runbook race"}
 json.dump(rec, open("bench_artifacts/crc_variant_winner.json", "w"))
 if sys.argv[3] != "bench_artifacts":
     json.dump(rec, open(sys.argv[3] + "/crc_variant_winner.json",
                         "w"))' "$BEST" "$STAMP" "$OUT"
+else
+    echo "[runbook] race produced no winner; defaulting to pallas" \
+        "(not persisted)" >&2
+    BEST=pallas
+fi
+echo "[runbook] winning variant: $BEST" >&2
 
 echo "[runbook $STAMP] full bench with BENCH_CRC_VARIANT=$BEST" >&2
 BENCH_CRC_VARIANT=$BEST timeout 3000 python bench.py \

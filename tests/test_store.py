@@ -507,3 +507,28 @@ def test_watch_recursive_inside_hidden_subtree_fires():
     assert ev is not None
     assert ev.action == "create"
     assert ev.node.key == "/_foo/bar/baz"
+
+
+def test_clean_path_fast_path_parity():
+    """clean_path's already-clean fast path must agree byte-for-byte
+    with the normpath slow path (Go path.Clean semantics) on every
+    shape, including the ones the fast-path conditions exclude."""
+    import itertools
+    import posixpath
+
+    from etcd_tpu.store.store import clean_path
+
+    def oracle(p):
+        out = posixpath.normpath(posixpath.join("/", p))
+        return out[1:] if out.startswith("//") else out
+
+    cases = ["/", "/a", "/a/b", "a", "", "//a", "/a//b", "/a/",
+             "/a/./b", "/a/../b", "/..", "/.", "/a/..", "/a/.",
+             "/.a", "/..a", "/a/.hidden", "a/b/", "/./", "/../x",
+             "/a/b/c/d", "/_hidden/k"]
+    for parts in itertools.product(["a", ".", "..", "", "b."],
+                                   repeat=3):
+        cases.append("/" + "/".join(parts))
+        cases.append("/".join(parts))
+    for p in cases:
+        assert clean_path(p) == oracle(p), repr(p)
