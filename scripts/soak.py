@@ -1,0 +1,123 @@
+"""Long-running co-hosted-server soak: continuous mixed load, RSS
+and throughput sampled on a cadence — the stability/leak evidence a
+point-in-time suite cannot give.
+
+    python scripts/soak.py [MINUTES] [GROUPS]     (default 30, 256)
+
+Load mix per iteration: PUTs across G namespaces (round-robin), a
+GET, a periodic DELETE, a TTL key, and a watch register+fire+drain.
+Prints one status line per ~30 s (elapsed, ops, RSS) and a final
+JSON summary; nonzero exit on any op error or an RSS slope that
+doubles the post-warmup baseline.
+"""
+
+import json
+import os
+import resource
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def main() -> int:
+    minutes = float(sys.argv[1]) if len(sys.argv) > 1 else 30.0
+    g = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from etcd_tpu.server.multigroup import MultiGroupServer
+    from etcd_tpu.wire.requests import Request
+
+    d = tempfile.mkdtemp(prefix="soak")
+    srv = MultiGroupServer(d, g=g, m=3, cap=64)
+    srv.start()
+    rid = [0]
+
+    def req(**kw):
+        rid[0] += 1
+        return Request(id=rid[0], **kw)
+
+    t0 = time.time()
+    deadline = t0 + minutes * 60
+    next_report = t0 + 30
+    ops = errors = 0
+    watch_fired = 0
+    baseline_rss = None
+    samples = []
+    i = 0
+    try:
+        while time.time() < deadline:
+            ns = f"/ns{i % g}"
+            try:
+                srv.do(req(method="PUT", path=f"{ns}/k{i % 17}",
+                           val=f"v{i}"), timeout=30)
+                ops += 1
+                if i % 7 == 0:
+                    srv.do(req(method="GET", path=f"{ns}/k{i % 17}"))
+                    ops += 1
+                if i % 31 == 0:
+                    srv.do(req(method="DELETE",
+                               path=f"{ns}/k{i % 17}"), timeout=30)
+                    ops += 1
+                if i % 13 == 0:
+                    srv.do(req(method="PUT", path=f"{ns}/ttl",
+                               val="x",
+                               expiration=int(
+                                   (time.time() + 2) * 1e9)),
+                           timeout=30)
+                    ops += 1
+                if i % 11 == 0:
+                    w = srv.store.watch(f"{ns}/w", False, False, 0)
+                    srv.do(req(method="PUT", path=f"{ns}/w",
+                               val=f"w{i}"), timeout=30)
+                    ops += 1
+                    if w.next_event(timeout=10) is not None:
+                        watch_fired += 1
+                    w.remove()
+            except Exception as e:  # any op failure fails the soak
+                errors += 1
+                print(f"op error at i={i}: {e!r}", flush=True)
+                if errors > 5:
+                    break
+            i += 1
+            now = time.time()
+            if now >= next_report:
+                cur = rss_mb()
+                if baseline_rss is None and now - t0 > 120:
+                    baseline_rss = cur  # post-warmup baseline
+                samples.append({"t_s": round(now - t0, 1),
+                                "ops": ops, "rss_mb": round(cur, 1)})
+                print(json.dumps(samples[-1]), flush=True)
+                next_report = now + 30
+    finally:
+        try:
+            srv.stop()
+        except Exception:
+            pass
+        shutil.rmtree(d, ignore_errors=True)
+
+    final = rss_mb()
+    leak = (baseline_rss is not None and final > 2 * baseline_rss)
+    summary = {
+        "minutes": round((time.time() - t0) / 60, 1), "groups": g,
+        "ops": ops, "errors": errors, "watch_fired": watch_fired,
+        "ops_per_sec": round(ops / max(1e-9, time.time() - t0), 1),
+        "rss_baseline_mb": round(baseline_rss or 0, 1),
+        "rss_final_mb": round(final, 1), "rss_doubled": leak,
+        "clean": errors == 0 and not leak,
+    }
+    print(json.dumps(summary), flush=True)
+    return 0 if summary["clean"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
