@@ -1204,11 +1204,18 @@ def main():
             if device_ok:
                 # data row only on a clean probe pass — a stall
                 # already wrote its outcome row, and a second row of
-                # nulls would mask it from latest-row readers
-                checkpoint("env_ceiling", {
-                    "tflops_bf16":
-                        extra.get("env_matmul_tflops_bf16"),
-                    "tops_int8": extra.get("env_matmul_tops_int8")})
+                # nulls would mask it from latest-row readers.  An
+                # in-probe exception returns None with stage status
+                # "ok" and device_ok still True; both-None is that
+                # failure, so record it explicitly instead of a
+                # nulls row that reads as a clean pass.
+                bf16 = extra.get("env_matmul_tflops_bf16")
+                int8 = extra.get("env_matmul_tops_int8")
+                if bf16 is None and int8 is None:
+                    checkpoint("env_ceiling", {"outcome": "failed"})
+                else:
+                    checkpoint("env_ceiling", {
+                        "tflops_bf16": bf16, "tops_int8": int8})
 
         sustain_iters = SUSTAIN_ITERS or (
             32 if backend == "tpu" else 8)

@@ -1,0 +1,61 @@
+"""Repo-native static analysis (the `go vet` analog for this tree).
+
+The reference leaned on ``go vet`` and the race detector; this package
+is the same idea specialized to THIS codebase's three failure classes
+that cost whole rounds and that the 6-minute suite cannot see:
+
+- **tracer-purity** (purity.py): host syncs and impure calls inside
+  code reachable from ``jax.jit``/``vmap``/``pallas_call`` roots —
+  ``.item()``, ``int()/float()/bool()`` on traced values,
+  ``np.*`` on traced values, Python ``if``/``while`` on traced names,
+  wall-clock/random calls that would bake into a trace.
+- **lock-discipline** (locks.py): the lock-acquisition graph across
+  the threaded store/server tier — cycles (deadlock risk) and writes
+  to attributes the rest of the class only touches under a lock.
+- **durability-ordering** (durability.py): in the WAL and the
+  snapshotter, every path from a write/rename/unlink to a return must
+  pass through flush+fsync (acks only follow fsync — the contract
+  torn-tail repair relies on).
+- **error-vocabulary** (errorvocab.py): every ``raise`` on the
+  client-visible tier resolves to the numeric vocabulary in
+  utils/errors.py or an allow-listed internal type.
+
+``scripts/lint`` runs the registry over the tree and gates on
+``analysis_baseline.json`` (accepted legacy findings, each with a
+one-line justification); ``tests/test_analysis.py`` wires the gate
+into tier-1 and proves each checker fires on seeded violations.
+
+The engine is stdlib-``ast`` only — no third-party deps, safe to run
+anywhere the repo imports.
+"""
+
+from .durability import DurabilityOrderingChecker
+from .engine import (
+    Baseline,
+    Finding,
+    load_baseline,
+    run_checkers,
+)
+from .errorvocab import ErrorVocabularyChecker
+from .locks import LockDisciplineChecker
+from .purity import TracerPurityChecker
+
+#: the registry scripts/lint and tests/test_analysis.py run
+ALL_CHECKERS = (
+    TracerPurityChecker(),
+    LockDisciplineChecker(),
+    DurabilityOrderingChecker(),
+    ErrorVocabularyChecker(),
+)
+
+__all__ = [
+    "ALL_CHECKERS",
+    "Baseline",
+    "DurabilityOrderingChecker",
+    "ErrorVocabularyChecker",
+    "Finding",
+    "LockDisciplineChecker",
+    "TracerPurityChecker",
+    "load_baseline",
+    "run_checkers",
+]

@@ -1,0 +1,231 @@
+"""durability-ordering: write → fsync before any return.
+
+The WAL/snapshot contract this tree's crash-recovery proofs lean on
+(torn-tail repair, never-acked-tail drop) is "acks only follow
+fsync".  Mechanically: in ``wal/wal.py`` and ``snap/snapshotter.py``,
+every code path from a **mutation** — a file ``write``, an
+``encoder.encode``, ``os.rename/remove/unlink/truncate/replace`` —
+to a normal ``return`` (or falling off the function end) must pass
+through a **sync** — ``.sync()``, ``os.fsync``, or a ``*fsync*``
+helper (dir-fsync after unlink/rename included).  ``raise`` paths are
+exempt: an exception is not an ack.
+
+Calls to other functions in the same module propagate: a call to a
+function that can exit dirty marks the caller dirty (fixpoint), so a
+buffered writer like ``save_entry`` is flagged at ITS boundary and
+the composite ``save`` (which ends in ``sync()``) stays clean.
+Intentionally-deferred writers (the encoder seam) are baselined with
+justifications, not silenced in code.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Checker, Finding, dotted_name, iter_functions
+
+_MUTATING_OS = {"rename", "remove", "unlink", "truncate", "replace",
+                "ftruncate"}
+
+#: receivers whose ``.write`` is a digest update, not a file write
+_NON_FILE_WRITE_RECV = ("crc", "digest", "hash")
+
+
+def _last_component(node: ast.AST) -> str:
+    return dotted_name(node).split(".")[-1]
+
+
+class _PathState:
+    __slots__ = ("dirty", "op")
+
+    def __init__(self, dirty: bool = False, op: str = ""):
+        self.dirty = dirty
+        self.op = op  # the mutating call that set dirty (last wins)
+
+
+class _FnEval:
+    """Evaluate one function body: reports returns-while-dirty and
+    whether the function can exit dirty (for caller propagation)."""
+
+    def __init__(self, checker, relpath, scope, fn,
+                 dirty_exit: dict[str, bool]):
+        self.c = checker
+        self.relpath = relpath
+        self.scope = scope
+        self.fn = fn
+        self.dirty_exit = dirty_exit
+        self.findings: list[Finding] = []
+        self.exits_dirty = False
+
+    def run(self) -> None:
+        st = _PathState(False)
+        out = self._block_st(self.fn.body, st)
+        if out.dirty:
+            # falling off the end returns None to the caller
+            self.exits_dirty = True
+            last = self.fn.body[-1]
+            self.findings.append(self._finding(
+                getattr(last, "lineno", self.fn.lineno), "end",
+                out.op))
+
+    def _finding(self, line: int, where: str, op: str) -> Finding:
+        # detail carries the exit kind + the mutating op token, NOT
+        # the line number: fingerprints must survive edits above the
+        # site, while a future unrelated mutation (different op) in
+        # an already-baselined function still gets a fresh
+        # fingerprint instead of hiding under the old justification
+        return Finding(
+            checker=self.c.name, path=self.relpath, line=line,
+            rule="unsynced-return", scope=self.scope,
+            message=("path from `" + (op or "a write/rename")
+                     + "` reaches "
+                     + ("the function end" if where == "end"
+                        else "a return")
+                     + " without flush+fsync — an ack could precede "
+                       "durability"),
+            detail=f"{where}:{op}")
+
+    # -- expression classification ---------------------------------------
+
+    def _call_effect(self, node: ast.Call) -> str:
+        """'sync' | 'dirty' | '' for one call node."""
+        f = node.func
+        name = dotted_name(f)
+        leaf = name.split(".")[-1]
+        if leaf == "fsync" or "fsync" in leaf or leaf == "sync":
+            return "sync"
+        if isinstance(f, ast.Attribute):
+            recv = _last_component(f.value)
+            if f.attr == "write" and not any(
+                    k in recv for k in _NON_FILE_WRITE_RECV):
+                return "dirty"
+            if f.attr == "encode" and "encoder" in recv:
+                return "dirty"
+            if name.startswith("os.") and f.attr in _MUTATING_OS:
+                return "dirty"
+        # intra-module propagation by bare callee name
+        if self.dirty_exit.get(leaf):
+            return "dirty"
+        return ""
+
+    def _scan_expr(self, node: ast.AST, st: _PathState) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                eff = self._call_effect(sub)
+                if eff == "dirty":
+                    st.dirty = True
+                    st.op = dotted_name(sub.func) or st.op
+                elif eff == "sync":
+                    st.dirty = False
+
+    # -- statements ------------------------------------------------------
+
+    @staticmethod
+    def _merge(st: _PathState, *outs: _PathState) -> None:
+        st.dirty = any(o.dirty for o in outs)
+        for o in outs:
+            if o.dirty:
+                st.op = o.op
+                break
+
+    def _block_st(self, stmts, st_in: _PathState) -> _PathState:
+        st = _PathState(st_in.dirty, st_in.op)
+        for stmt in stmts:
+            self._stmt(stmt, st)
+        return st
+
+    def _stmt(self, stmt, st: _PathState) -> None:
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._scan_expr(stmt.value, st)
+            if st.dirty:
+                self.findings.append(
+                    self._finding(stmt.lineno, "return", st.op))
+                self.exits_dirty = True
+                st.dirty = False  # reported once per path
+            return
+        if isinstance(stmt, ast.Raise):
+            st.dirty = False  # error propagation is not an ack
+            return
+        if isinstance(stmt, ast.If):
+            self._scan_expr(stmt.test, st)
+            a = self._block_st(stmt.body, st)
+            b = self._block_st(stmt.orelse, st)
+            self._merge(st, a, b)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            self._scan_expr(
+                stmt.iter if isinstance(stmt, (ast.For, ast.AsyncFor))
+                else stmt.test, st)
+            entry = _PathState(st.dirty, st.op)
+            body = self._block_st(stmt.body, entry)
+            after = _PathState(entry.dirty or body.dirty,
+                               body.op if body.dirty else entry.op)
+            els = self._block_st(stmt.orelse, after)
+            self._merge(st, entry, body, els)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._scan_expr(item.context_expr, st)
+            out = self._block_st(stmt.body, st)
+            st.dirty, st.op = out.dirty, out.op
+            return
+        if isinstance(stmt, ast.Try):
+            body = self._block_st(stmt.body, st)
+            outs = [body]
+            for h in stmt.handlers:
+                pre = _PathState(st.dirty or body.dirty,
+                                 body.op if body.dirty else st.op)
+                outs.append(self._block_st(h.body, pre))
+            els = self._block_st(stmt.orelse, body)
+            merged = _PathState()
+            self._merge(merged, *outs, els)
+            if stmt.finalbody:
+                merged = self._block_st(stmt.finalbody, merged)
+            st.dirty, st.op = merged.dirty, merged.op
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs evaluated separately
+        for sub in ast.iter_child_nodes(stmt):
+            if isinstance(sub, ast.expr):
+                self._scan_expr(sub, st)
+
+
+class DurabilityOrderingChecker(Checker):
+    name = "durability-ordering"
+    targets = (
+        "etcd_tpu/wal/wal.py",
+        "etcd_tpu/snap/snapshotter.py",
+    )
+
+    def check(self, relpath, tree, source, root=None):
+        fns = list(iter_functions(tree))
+        # fixpoint: which functions can exit dirty (by bare name —
+        # good enough within one module)
+        dirty_exit: dict[str, bool] = {}
+        for _ in range(4):
+            changed = False
+            for scope, fn in fns:
+                ev = _FnEval(self, relpath, scope, fn, dirty_exit)
+                ev.run()
+                prev = dirty_exit.get(fn.name, False)
+                if ev.exits_dirty != prev:
+                    dirty_exit[fn.name] = ev.exits_dirty
+                    changed = True
+            if not changed:
+                break
+        findings: list[Finding] = []
+        for scope, fn in fns:
+            ev = _FnEval(self, relpath, scope, fn, dirty_exit)
+            ev.run()
+            findings.extend(ev.findings)
+        # de-dup (fixpoint pass may emit duplicates)
+        seen = set()
+        out = []
+        for f in findings:
+            key = (f.fingerprint, f.line)
+            if key not in seen:
+                seen.add(key)
+                out.append(f)
+        return out

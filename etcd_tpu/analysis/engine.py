@@ -1,0 +1,213 @@
+"""Visitor engine + finding/baseline plumbing shared by the checkers.
+
+Design points:
+
+- One parsed AST per file per run (checkers share the cache).
+- Findings carry a **stable fingerprint** (checker, file, enclosing
+  scope, rule, detail — never the line number) so routine edits above
+  a legacy finding don't churn the baseline.
+- The baseline is a committed JSON file mapping fingerprint →
+  metadata + a one-line human justification.  ``scripts/lint
+  --baseline`` refreshes it; a finding whose fingerprint is absent
+  fails the gate.
+- ``# lint: ok(<checker>)`` on the flagged line is an inline
+  suppression for cases where a comment at the site beats a baseline
+  entry.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Finding:
+    checker: str          # checker name ("tracer-purity", ...)
+    path: str             # repo-relative posix path
+    line: int             # 1-based line (display only, not identity)
+    rule: str             # short rule id ("host-cast", "lock-cycle")
+    scope: str            # enclosing Class.function ("" = module)
+    message: str          # human sentence
+    detail: str = ""      # small stable token (attr/call name)
+
+    @property
+    def fingerprint(self) -> str:
+        key = "|".join((self.checker, self.path, self.scope,
+                        self.rule, self.detail))
+        return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.checker}/"
+                f"{self.rule}] {self.message}"
+                f"  (fingerprint {self.fingerprint})")
+
+
+class Checker:
+    """One registered analysis.  Subclasses set ``name`` and
+    ``targets`` (repo-relative paths or ``dir/`` prefixes) and
+    implement ``check``."""
+
+    name = "base"
+    targets: tuple[str, ...] = ()
+
+    def wants(self, relpath: str) -> bool:
+        for t in self.targets:
+            if relpath == t or (t.endswith("/")
+                                and relpath.startswith(t)):
+                return True
+        return False
+
+    def check(self, relpath: str, tree: ast.AST, source: str,
+              root: str | None = None
+              ) -> list[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+@dataclass
+class Baseline:
+    """Accepted legacy findings: fingerprint → entry with a one-line
+    ``justification`` (required — the gate rejects a baseline entry
+    without one)."""
+
+    entries: dict[str, dict] = field(default_factory=dict)
+
+    def accepts(self, f: Finding) -> bool:
+        return f.fingerprint in self.entries
+
+    def unjustified(self) -> list[str]:
+        return [fp for fp, e in sorted(self.entries.items())
+                if not str(e.get("justification", "")).strip()
+                or str(e.get("justification", "")).startswith("TODO")]
+
+
+def load_baseline(path: str) -> Baseline:
+    if not os.path.exists(path):
+        return Baseline()
+    with open(path) as f:
+        doc = json.load(f)
+    return Baseline(entries=doc.get("entries", {}))
+
+
+def save_baseline(path: str, findings: list[Finding],
+                  prior: Baseline) -> Baseline:
+    """Write the current findings as the accepted baseline, keeping
+    prior justifications for fingerprints that still fire; new
+    entries get a TODO the author must replace (the gate and the
+    tier-1 test both reject TODO justifications)."""
+    entries: dict[str, dict] = {}
+    for f in findings:
+        old = prior.entries.get(f.fingerprint, {})
+        entries[f.fingerprint] = {
+            "checker": f.checker,
+            "path": f.path,
+            "rule": f.rule,
+            "scope": f.scope,
+            "detail": f.detail,
+            "message": f.message,
+            "justification": old.get("justification",
+                                     "TODO: justify or fix"),
+        }
+    doc = {"version": 1, "entries": dict(sorted(entries.items()))}
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    return Baseline(entries=entries)
+
+
+def _suppressed(source_lines: list[str], f: Finding) -> bool:
+    if not (1 <= f.line <= len(source_lines)):
+        return False
+    return f"lint: ok({f.checker})" in source_lines[f.line - 1]
+
+
+def run_checkers(root: str, checkers,
+                 paths: list[str] | None = None) -> list[Finding]:
+    """Run every checker over its target files under ``root``.
+    ``paths`` restricts the run (repo-relative; ``./``-prefixes are
+    normalized, and a path that selects no target file raises — a
+    silent zero-findings pass on a typo'd path would read as
+    clean).  Returns findings sorted by (path, line), inline
+    suppressions already dropped."""
+    if paths is not None:
+        paths = [os.path.normpath(p).replace(os.sep, "/")
+                 for p in paths]
+    wanted: dict[str, list] = {}
+    for c in checkers:
+        for t in c.targets:
+            if t.endswith("/"):
+                base = os.path.join(root, t)
+                for dirpath, _dirs, files in os.walk(base):
+                    for fn in files:
+                        if not fn.endswith(".py"):
+                            continue
+                        rel = os.path.relpath(
+                            os.path.join(dirpath, fn), root)
+                        rel = rel.replace(os.sep, "/")
+                        wanted.setdefault(rel, []).append(c)
+            else:
+                if os.path.exists(os.path.join(root, t)):
+                    wanted.setdefault(t, []).append(c)
+
+    if paths is not None:
+        unknown = [p for p in paths if p not in wanted]
+        if unknown:
+            raise ValueError(
+                f"path(s) select no analysis target: {unknown} "
+                f"(targets are repo-relative, e.g. "
+                f"etcd_tpu/wal/wal.py)")
+
+    findings: list[Finding] = []
+    for rel in sorted(wanted):
+        if paths is not None and rel not in paths:
+            continue
+        with open(os.path.join(root, rel)) as fh:
+            source = fh.read()
+        tree = ast.parse(source, filename=rel)
+        lines = source.splitlines()
+        for c in wanted[rel]:
+            for f in c.check(rel, tree, source, root=root):
+                if not _suppressed(lines, f):
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# -- small shared AST helpers -------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> str:
+    """``a.b.c`` for Name/Attribute chains, "" otherwise."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def iter_functions(tree: ast.AST):
+    """Yield (scope, node) for every function/method in the module;
+    scope is ``Class.name`` or ``name`` (nested: ``outer.inner``)."""
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                scope = f"{prefix}.{child.name}" if prefix \
+                    else child.name
+                yield scope, child
+                yield from walk(child, scope)
+            elif isinstance(child, ast.ClassDef):
+                name = f"{prefix}.{child.name}" if prefix \
+                    else child.name
+                yield from walk(child, name)
+            else:
+                yield from walk(child, prefix)
+
+    yield from walk(tree, "")

@@ -1,0 +1,396 @@
+"""tracer-purity: host-sync and impurity hazards in traced code.
+
+Roots are functions the module hands to a tracer: ``@jax.jit`` /
+``@partial(jax.jit, static_argnames=...)`` decorations, and functions
+passed to ``jax.jit`` / ``jax.vmap`` / ``jax.shard_map`` /
+``pl.pallas_call`` call sites.  From each root a light taint walk
+marks traced values: non-static parameters are tainted; assignments
+propagate; ``.shape``/``.dtype``/``.ndim``/``.size`` and ``len()``
+de-taint (static at trace time).  Intra-module callees invoked with a
+tainted argument are visited too (their matching params tainted).
+
+Hazards (each a finding):
+
+- ``host-sync``: ``x.item()`` / ``np.<anything>(x)`` /
+  ``np.asarray(x)`` on a tainted value — a device→host transfer that
+  serializes the trace (or a silent constant-fold of a traced value).
+- ``host-cast``: ``int()/float()/bool()/complex()`` of a tainted
+  value — concretization error at trace time or a hidden sync.
+- ``traced-branch``: Python ``if``/``while`` on a tainted test
+  (``is None`` checks excluded — they are Python-level, not traced).
+- ``traced-range``: ``for _ in range(tainted)`` / iterating a tainted
+  value — data-dependent Python loop inside a trace.
+- ``impure-call``: wall-clock/random/env reads inside traced code —
+  they bake one host value into the compiled executable
+  (``time.*``, ``random.*``, ``np.random.*``, ``datetime.*.now``,
+  ``os.environ``/``os.getenv``, ``uuid.*``).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Checker, Finding, dotted_name, iter_functions
+
+#: attribute names whose access yields a static (host) value even on
+#: a traced array
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "sharding"}
+
+#: call-wrappers that make their function argument a trace root
+_ROOT_TAKERS = {"jit", "vmap", "pmap", "shard_map", "pallas_call",
+                "grad", "value_and_grad", "checkpoint", "remat"}
+
+_HOST_CASTS = {"int", "float", "bool", "complex"}
+
+_DETAINT_CALLS = {"len", "isinstance", "type", "getattr", "hasattr"}
+
+_IMPURE_PREFIXES = (
+    "time.", "random.", "np.random", "numpy.random",
+    "datetime.", "os.environ", "os.getenv", "os.urandom", "uuid.",
+)
+
+
+def _is_impure_call(name: str) -> bool:
+    if not name:
+        return False
+    return any(name == p.rstrip(".") or name.startswith(p)
+               for p in _IMPURE_PREFIXES)
+
+
+def _decorator_root(dec: ast.AST) -> tuple[bool, tuple[str, ...]]:
+    """(is-jit-root, static_argnames) for one decorator node."""
+    name = dotted_name(dec)
+    if name.split(".")[-1] in ("jit", "pjit"):
+        return True, ()
+    if isinstance(dec, ast.Call):
+        fname = dotted_name(dec.func)
+        # functools.partial(jax.jit, static_argnames=(...)) and
+        # jax.jit(..., static_argnames=...) as a decorator factory
+        inner = [dotted_name(a) for a in dec.args]
+        is_partial_jit = (fname.split(".")[-1] == "partial"
+                          and any(n.split(".")[-1] in ("jit", "pjit")
+                                  for n in inner))
+        is_jit_call = fname.split(".")[-1] in ("jit", "pjit")
+        if is_partial_jit or is_jit_call:
+            statics: list[str] = []
+            for kw in dec.keywords:
+                if kw.arg in ("static_argnames", "static_argnums") \
+                        and isinstance(kw.value,
+                                       (ast.Tuple, ast.List)):
+                    for el in kw.value.elts:
+                        if isinstance(el, ast.Constant) \
+                                and isinstance(el.value, str):
+                            statics.append(el.value)
+                elif kw.arg == "static_argnames" \
+                        and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    statics.append(kw.value.value)
+            return True, tuple(statics)
+    return False, ()
+
+
+class _FunctionIndex:
+    """name → [(scope, node)] for every def in the module."""
+
+    def __init__(self, tree: ast.AST):
+        self.by_name: dict[str, list] = {}
+        for scope, node in iter_functions(tree):
+            self.by_name.setdefault(node.name, []).append(
+                (scope, node))
+
+
+class _TaintVisitor(ast.NodeVisitor):
+    def __init__(self, checker: "TracerPurityChecker", relpath: str,
+                 scope: str, node: ast.AST, tainted: set[str],
+                 index: _FunctionIndex, findings: list[Finding],
+                 visited: set):
+        self.c = checker
+        self.relpath = relpath
+        self.scope = scope
+        self.tainted = set(tainted)
+        self.index = index
+        self.findings = findings
+        self.visited = visited
+        self._body(node)
+
+    # -- taint rules -----------------------------------------------------
+
+    def is_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func)
+            if fname.split(".")[-1] in _DETAINT_CALLS:
+                return False
+            if self.is_tainted(node.func):
+                return True
+            return any(self.is_tainted(a) for a in node.args) or any(
+                self.is_tainted(k.value) for k in node.keywords)
+        if isinstance(node, ast.Subscript):
+            return (self.is_tainted(node.value)
+                    or self.is_tainted(node.slice))
+        if isinstance(node, (ast.BinOp,)):
+            return (self.is_tainted(node.left)
+                    or self.is_tainted(node.right))
+        if isinstance(node, ast.UnaryOp):
+            return self.is_tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.is_tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            # identity checks are Python-level, never traced
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in node.ops):
+                return False
+            return (self.is_tainted(node.left)
+                    or any(self.is_tainted(c)
+                           for c in node.comparators))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.is_tainted(e) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return (self.is_tainted(node.body)
+                    or self.is_tainted(node.orelse))
+        if isinstance(node, ast.Starred):
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.NamedExpr):
+            return self.is_tainted(node.value)
+        return False
+
+    def _flag(self, node: ast.AST, rule: str, message: str,
+              detail: str) -> None:
+        self.findings.append(Finding(
+            checker=self.c.name, path=self.relpath,
+            line=getattr(node, "lineno", 0), rule=rule,
+            scope=self.scope, message=message, detail=detail))
+
+    def _body(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Lambda):
+            self.visit(node.body)
+            return
+        for stmt in node.body:
+            self.visit(stmt)
+
+    # -- statements ------------------------------------------------------
+
+    def _bind(self, target: ast.AST, tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._bind(el, tainted)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, tainted)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.visit(node.value)
+        t = self.is_tainted(node.value)
+        for target in node.targets:
+            self._bind(target, t)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.visit(node.value)
+        if self.is_tainted(node.value):
+            self._bind(node.target, True)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self.visit(node.value)
+            self._bind(node.target, self.is_tainted(node.value))
+
+    def visit_If(self, node: ast.If) -> None:
+        if self.is_tainted(node.test):
+            self._flag(node, "traced-branch",
+                       "Python `if` on a traced value inside jitted "
+                       "code — use jnp.where/lax.cond",
+                       ast.unparse(node.test)[:60])
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        if self.is_tainted(node.test):
+            self._flag(node, "traced-branch",
+                       "Python `while` on a traced value inside "
+                       "jitted code — use lax.while_loop",
+                       ast.unparse(node.test)[:60])
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        # NOTE: iterating a tainted value is NOT flagged — tuples of
+        # traced pytrees (`for st in states`) are idiomatic jax; only
+        # a data-dependent `range()` bound is a real hazard
+        it = node.iter
+        if isinstance(it, ast.Call) \
+                and dotted_name(it.func) == "range":
+            if any(self.is_tainted(a) for a in it.args):
+                self._flag(node, "traced-range",
+                           "`range()` over a traced value — "
+                           "data-dependent Python loop in a trace",
+                           ast.unparse(it)[:60])
+        self.generic_visit(node)
+
+    # -- calls -----------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fname = dotted_name(node.func)
+        leaf = fname.split(".")[-1]
+
+        if _is_impure_call(fname):
+            self._flag(node, "impure-call",
+                       f"impure call `{fname}` inside traced code — "
+                       f"the traced value is frozen at compile time",
+                       fname)
+
+        # x.item(): device→host sync
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "item" \
+                and self.is_tainted(node.func.value):
+            self._flag(node, "host-sync",
+                       "`.item()` on a traced value — host sync "
+                       "inside jitted code",
+                       ast.unparse(node.func.value)[:60])
+
+        # int()/float()/bool() on a traced value
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in _HOST_CASTS and node.args \
+                and self.is_tainted(node.args[0]):
+            self._flag(node, "host-cast",
+                       f"`{node.func.id}()` of a traced value — "
+                       f"concretization/sync inside jitted code",
+                       ast.unparse(node.args[0])[:60])
+
+        # np.* applied to a traced value (np.asarray included)
+        root = fname.split(".")[0]
+        if root in ("np", "numpy", "onp") and (
+                any(self.is_tainted(a) for a in node.args)
+                or any(self.is_tainted(k.value)
+                       for k in node.keywords)):
+            self._flag(node, "host-sync",
+                       f"`{fname}()` on a traced value — host numpy "
+                       f"op inside jitted code",
+                       fname)
+
+        # follow intra-module callees invoked with tainted args
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in self.index.by_name:
+            tainted_args = [self.is_tainted(a) for a in node.args]
+            if any(tainted_args):
+                for scope, fn in self.index.by_name[node.func.id]:
+                    self.c._visit_function(
+                        self.relpath, scope, fn,
+                        self._callee_taint(fn, node, tainted_args),
+                        self.index, self.findings, self.visited,
+                        leaf)
+        self.generic_visit(node)
+
+    @staticmethod
+    def _callee_taint(fn, call: ast.Call,
+                      tainted_args: list[bool]) -> set[str]:
+        params = [a.arg for a in fn.args.args]
+        out = set()
+        for i, t in enumerate(tainted_args):
+            if t and i < len(params):
+                out.add(params[i])
+        for kw in call.keywords:
+            if kw.arg and kw.arg in params:
+                out.add(kw.arg)  # conservatively tainted
+        return out
+
+    # nested defs: visited when called/passed, not on definition
+    def visit_FunctionDef(self, node):  # noqa: D102
+        pass
+
+    def visit_AsyncFunctionDef(self, node):  # noqa: D102
+        pass
+
+    def visit_Lambda(self, node):  # noqa: D102
+        pass
+
+
+class TracerPurityChecker(Checker):
+    name = "tracer-purity"
+    targets = (
+        "etcd_tpu/ops/",
+        "etcd_tpu/raft/batched.py",
+        "etcd_tpu/raft/multiraft.py",
+        "etcd_tpu/wal/replay_device.py",
+        "etcd_tpu/parallel/mesh.py",
+    )
+
+    def check(self, relpath, tree, source, root=None):
+        findings: list[Finding] = []
+        index = _FunctionIndex(tree)
+        visited: set[tuple[str, frozenset]] = set()
+        roots = self._find_roots(tree, index)
+        for scope, node, statics in roots:
+            tainted = self._param_taint(node, statics)
+            self._visit_function(relpath, scope, node, tainted,
+                                 index, findings, visited, "root")
+        # de-dup identical findings found via multiple paths
+        seen = set()
+        out = []
+        for f in findings:
+            key = (f.fingerprint, f.line)
+            if key not in seen:
+                seen.add(key)
+                out.append(f)
+        return out
+
+    @staticmethod
+    def _param_taint(node, statics) -> set[str]:
+        if isinstance(node, ast.Lambda):
+            return {a.arg for a in node.args.args}
+        args = node.args
+        names = [a.arg for a in (args.posonlyargs + args.args
+                                 + args.kwonlyargs)]
+        return {n for n in names if n not in statics
+                and n not in ("self", "cls")}
+
+    def _find_roots(self, tree, index):
+        roots = []
+        for scope, node in iter_functions(tree):
+            for dec in node.decorator_list:
+                is_root, statics = _decorator_root(dec)
+                if is_root:
+                    roots.append((scope, node, statics))
+                    break
+        # functions passed to jit/vmap/shard_map/pallas_call(...)
+        for call in ast.walk(tree):
+            if not isinstance(call, ast.Call):
+                continue
+            leaf = dotted_name(call.func).split(".")[-1]
+            if leaf not in _ROOT_TAKERS:
+                continue
+            for arg in call.args:
+                if isinstance(arg, ast.Name) \
+                        and arg.id in index.by_name:
+                    for scope, fn in index.by_name[arg.id]:
+                        roots.append((scope, fn, ()))
+                elif isinstance(arg, ast.Lambda):
+                    roots.append(("<lambda>", arg, ()))
+            for kw in call.keywords:
+                if isinstance(kw.value, ast.Name) \
+                        and kw.value.id in index.by_name:
+                    for scope, fn in index.by_name[kw.value.id]:
+                        roots.append((scope, fn, ()))
+        # stable de-dup by (scope, id)
+        seen = set()
+        out = []
+        for scope, node, statics in roots:
+            if id(node) not in seen:
+                seen.add(id(node))
+                out.append((scope, node, statics))
+        return out
+
+    def _visit_function(self, relpath, scope, node, tainted, index,
+                        findings, visited, via) -> None:
+        key = (id(node), frozenset(tainted))
+        if key in visited or len(visited) > 4000:
+            return
+        visited.add(key)
+        _TaintVisitor(self, relpath, scope, node, tainted, index,
+                      findings, visited)

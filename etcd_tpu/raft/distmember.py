@@ -133,12 +133,22 @@ class DistMember:
         # AddMember, batched state being static-shaped)
         self.g, self.m, self.slot, self.cap = g, m, slot, cap
         self.e = max_batch_ents
-        self.election = election
+        # the stratified election bands (_draw_timeouts) carve m
+        # disjoint width->=1 bands out of [election, 2*election);
+        # with election < m that is impossible — w clamps to 1 and
+        # high slots' bands spill past 2*election, silently breaking
+        # the drill-calibrated worst case.  Clamp up so the
+        # documented ``<= 2*election`` recovery bound holds on every
+        # config (an election of at least m ticks is also the only
+        # sane operating point: fewer ticks than hosts cannot
+        # stagger anything).
+        self.election = max(election, m)
         # kept: the timeout is re-drawn per campaign (see
         # begin_campaign), not fixed at init
         self._rng = np.random.default_rng(
             slot if seed is None else seed)
-        st = init_groups(g, m, cap, election=election, live=live)
+        st = init_groups(g, m, cap, election=self.election,
+                         live=live)
         st = st._replace(timeout=jnp.asarray(
             self._draw_timeouts(), jnp.int32))
         self.state = st

@@ -874,8 +874,13 @@ class DistServer:
                     # lanes that fire lost their leader
                 if fire.any():
                     self._campaign(fire)
-            if self._need_pull:
+            with self.lock:
+                # handle_frame sets the flag under the lock; an
+                # unlocked test-and-clear here could lose a pull
+                # request that lands between the read and the write
+                need_pull = self._need_pull
                 self._need_pull = False
+            if need_pull:
                 self._pull_snapshot()
             self._leader_round(batch)
 

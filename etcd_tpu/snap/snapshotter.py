@@ -14,6 +14,7 @@ import os
 from typing import Callable
 
 from ..crc import value as crc_value
+from ..utils.fsio import fsync_dir
 from ..wire import SnapPb, Snapshot, is_empty_snap
 from ..wire.proto import ProtoError
 
@@ -63,8 +64,15 @@ class Snapshotter:
         b = snapshot.marshal()
         crc = self.crc_fn(b)
         d = SnapPb(crc=crc, data=b).marshal()
+        # contents + directory entry fsynced before returning: the
+        # callers cut the WAL right after save_snap, so a snapshot
+        # that evaporates in a crash would strand the log tail
+        # behind a segment boundary with no state to stand on
         with open(os.path.join(self.dir, fname), "wb") as f:
             f.write(d)
+            f.flush()
+            os.fsync(f.fileno())
+        fsync_dir(self.dir)
 
     def load(self) -> Snapshot:
         """Newest-first, falling back across corrupt files
@@ -127,5 +135,9 @@ class Snapshotter:
         broken = path + ".broken"
         try:
             os.rename(path, broken)
+            # quarantine must stick across a crash — an un-fsynced
+            # rename can revert, and the corrupt file would then
+            # mask older good snapshots again on the next load
+            fsync_dir(os.path.dirname(path))
         except OSError as e:  # pragma: no cover
             log.warning("cannot rename broken snapshot %s: %s", path, e)

@@ -513,8 +513,9 @@ class Store:
     # -- stats -------------------------------------------------------------
 
     def json_stats(self) -> bytes:
-        self.stats.watchers = self.watcher_hub.count
-        return self.stats.to_json()
+        with self.world_lock:
+            self.stats.watchers = self.watcher_hub.count
+            return self.stats.to_json()
 
     def total_transactions(self) -> int:
         return self.stats.total_transactions()

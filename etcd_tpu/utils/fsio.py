@@ -1,0 +1,32 @@
+"""Durable-filesystem helpers shared by the WAL and the snapshotter.
+
+A file's *contents* become durable on ``fsync(fd)``; its *directory
+entry* (creation, rename, unlink) only becomes durable on an fsync of
+the containing directory.  The reference leans on the same pattern
+(``fileutil`` in later etcd); here it is one helper so the
+durability-ordering checker (etcd_tpu/analysis/durability.py) can
+recognize the seam by name.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def fsync_dir(dirpath: str) -> None:
+    """fsync a directory so entry mutations (create/rename/unlink)
+    inside it survive a crash.  Best-effort on platforms/filesystems
+    that reject directory fsync (some network filesystems): the
+    OSError is swallowed — matching the reference's fileutil
+    behavior — because the caller's own file fsync already happened
+    and there is nothing more a caller could do."""
+    try:
+        fd = os.open(dirpath, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)

@@ -25,6 +25,7 @@ import struct
 from typing import BinaryIO
 
 from ..crc import Digest
+from ..utils.fsio import fsync_dir
 from ..wire import Entry, HardState, Record
 from .errors import (
     CRCMismatchError,
@@ -230,6 +231,12 @@ class WAL:
         w.encoder = _Encoder(f, 0)
         w._save_crc(0)
         w.encoder.encode(Record(type=METADATA_TYPE, data=metadata))
+        # the header records and the segment's directory entry must
+        # be durable before the WAL is handed out — a crash between
+        # create() and the first save() must not lose the metadata
+        # record that every later open validates against
+        w.sync()
+        fsync_dir(dirpath)
         return w
 
     @classmethod
@@ -347,6 +354,15 @@ class WAL:
                     path = self.decoder.files[fi].name
                     if off is not None:
                         os.truncate(path, off)
+                        # the truncation itself must be durable
+                        # before replay returns: a crash after a
+                        # repaired-but-unsynced truncate would
+                        # resurrect the torn bytes on the next open
+                        tfd = os.open(path, os.O_RDONLY)
+                        try:
+                            os.fsync(tfd)
+                        finally:
+                            os.close(tfd)
                     doomed = self.decoder.files[fi + 1:]
                     # REMOVE, don't truncate-to-zero: a zero-length
                     # segment carries no metadata/CRC head record and
@@ -372,6 +388,7 @@ class WAL:
                         self.f = _open_append_0600(path)
                         self.seq, _ = parse_wal_name(
                             os.path.basename(path))
+                    fsync_dir(self.dir)
                     log.warning(
                         "wal: repaired torn tail: kept %s%s, removed "
                         "%d later file(s) (%s)",
@@ -458,6 +475,11 @@ class WAL:
         self.encoder = _Encoder(self.f, prev_crc)
         self._save_crc(prev_crc)
         self.encoder.encode(Record(type=METADATA_TYPE, data=self.md))
+        # new segment's header records + directory entry durable
+        # before any entry lands in it: a crash after cut() but
+        # before the next save() must leave an openable chain
+        self.sync()
+        fsync_dir(self.dir)
 
     def sync(self) -> None:
         if self.f is not None:

@@ -23,6 +23,23 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def rss_mb() -> float:
+    """CURRENT resident set from /proc/self/status VmRSS — the
+    sampled series and the leak gate need a value that can go DOWN;
+    ru_maxrss is the monotone peak (an early jit-compile spike would
+    inflate the post-warmup baseline and mask a real leak)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    # non-procfs platform: fall back to the peak (still monotone,
+    # but better than nothing)
+    return peak_rss_mb()
+
+
+def peak_rss_mb() -> float:
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
 
 
@@ -112,7 +129,8 @@ def main() -> int:
         "ops": ops, "errors": errors, "watch_fired": watch_fired,
         "ops_per_sec": round(ops / max(1e-9, time.time() - t0), 1),
         "rss_baseline_mb": round(baseline_rss or 0, 1),
-        "rss_final_mb": round(final, 1), "rss_doubled": leak,
+        "rss_final_mb": round(final, 1),
+        "rss_peak_mb": round(peak_rss_mb(), 1), "rss_doubled": leak,
         "clean": errors == 0 and not leak,
     }
     print(json.dumps(summary), flush=True)

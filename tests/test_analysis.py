@@ -1,0 +1,449 @@
+"""The static-analysis gate as a tier-1 test.
+
+Two halves:
+
+1. **Real tree**: running every checker over the repository yields no
+   finding outside ``analysis_baseline.json``, and every baseline
+   entry both carries a real justification and still fires (no stale
+   entries silently shadowing future regressions).
+2. **Seeded violations**: each checker fires on a minimal fixture
+   snippet containing the hazard it exists for, and stays quiet on
+   the corrected form — so a refactor that lobotomizes a checker
+   fails here, not months later in production.
+"""
+
+from __future__ import annotations
+
+import os
+import textwrap
+
+import pytest
+
+from etcd_tpu.analysis import (
+    ALL_CHECKERS,
+    DurabilityOrderingChecker,
+    ErrorVocabularyChecker,
+    LockDisciplineChecker,
+    TracerPurityChecker,
+    load_baseline,
+    run_checkers,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "analysis_baseline.json")
+
+_REAL_TREE: list = []
+
+
+def _real_tree_findings():
+    """One shared full-tree pass for the real-tree tests (the walk
+    parses ~25 files; no need to repeat it per test)."""
+    if not _REAL_TREE:
+        _REAL_TREE.append(run_checkers(REPO, ALL_CHECKERS))
+    return _REAL_TREE[0]
+
+
+def _fixture_root(tmp_path, relpath: str, body: str) -> str:
+    p = tmp_path / relpath
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(body))
+    return str(tmp_path)
+
+
+def _rules(findings) -> set[str]:
+    return {f.rule for f in findings}
+
+
+# -- 1. the real tree ---------------------------------------------------------
+
+
+def test_real_tree_has_no_new_findings():
+    baseline = load_baseline(BASELINE)
+    findings = _real_tree_findings()
+    fresh = [f for f in findings if not baseline.accepts(f)]
+    assert not fresh, "new static-analysis findings:\n" + "\n".join(
+        f.render() for f in fresh)
+
+
+def test_baseline_entries_are_justified_and_live():
+    baseline = load_baseline(BASELINE)
+    assert baseline.entries, "baseline unexpectedly empty"
+    assert not baseline.unjustified(), (
+        "baseline entries without a one-line justification: "
+        f"{baseline.unjustified()}")
+    findings = _real_tree_findings()
+    live = {f.fingerprint for f in findings}
+    stale = set(baseline.entries) - live
+    assert not stale, (
+        f"stale baseline entries (fixed findings still accepted — "
+        f"prune with scripts/lint --baseline): {sorted(stale)}")
+
+
+# -- 2. tracer-purity fires on seeded violations ------------------------------
+
+
+_PURITY_BAD = """
+    import time
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def bad(x, n):
+        if x > 0:                      # traced-branch
+            x = x + 1
+        k = int(x)                     # host-cast
+        v = x.sum().item()             # host-sync
+        h = np.asarray(x)              # host-sync (np on traced)
+        t = time.time()                # impure-call
+        for _ in range(n):             # traced-range
+            x = x * 2
+        return x + k + v + h.size + t
+"""
+
+_PURITY_GOOD = """
+    import functools
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnames=("flag", "n"))
+    def good(x, flag, n):
+        if flag:                       # static arg: fine
+            x = x + 1
+        if x is None:                  # identity check: fine
+            return x
+        w = x.shape[0]                 # shape access: fine
+        for _ in range(n):             # static bound: fine
+            x = x * 2
+        return jnp.where(x > 0, x, -x) + w
+"""
+
+
+def test_purity_fires_on_each_seeded_hazard(tmp_path):
+    root = _fixture_root(tmp_path, "etcd_tpu/ops/bad.py",
+                         _PURITY_BAD)
+    findings = run_checkers(root, [TracerPurityChecker()])
+    assert {"traced-branch", "host-cast", "host-sync",
+            "impure-call", "traced-range"} <= _rules(findings)
+
+
+def test_purity_quiet_on_clean_jit(tmp_path):
+    root = _fixture_root(tmp_path, "etcd_tpu/ops/good.py",
+                         _PURITY_GOOD)
+    assert run_checkers(root, [TracerPurityChecker()]) == []
+
+
+def test_purity_follows_callee_with_tainted_args(tmp_path):
+    root = _fixture_root(tmp_path, "etcd_tpu/ops/callee.py", """
+        import jax
+
+        def helper(y):
+            return float(y)            # host-cast, via call taint
+
+        @jax.jit
+        def root_fn(x):
+            return helper(x)
+    """)
+    findings = run_checkers(root, [TracerPurityChecker()])
+    assert any(f.rule == "host-cast" and f.scope == "helper"
+               for f in findings)
+
+
+# -- 3. lock-discipline fires on seeded violations ----------------------------
+
+
+_LOCKS_BAD = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self.a = threading.Lock()
+            self.b = threading.Lock()
+            self.n = 0
+
+        def fwd(self):
+            with self.a:
+                with self.b:           # a -> b
+                    self.n += 1
+
+        def rev(self):
+            with self.b:
+                with self.a:           # b -> a: cycle
+                    self.n += 1
+
+        def bare(self):
+            self.n = 5                 # unguarded-write
+"""
+
+
+def test_locks_fire_on_cycle_and_unguarded_write(tmp_path):
+    root = _fixture_root(tmp_path, "etcd_tpu/store/store.py",
+                         _LOCKS_BAD)
+    findings = run_checkers(root, [LockDisciplineChecker()])
+    assert "lock-cycle" in _rules(findings)
+    assert any(f.rule == "unguarded-write" and f.detail == "n"
+               for f in findings)
+
+
+def test_locks_respect_call_with_lock_held_convention(tmp_path):
+    root = _fixture_root(tmp_path, "etcd_tpu/store/store.py", """
+        import threading
+
+        class S:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.n = 0
+
+            def public(self):
+                with self.lock:
+                    self._locked_helper()
+
+            def other(self):
+                with self.lock:
+                    self._locked_helper()
+
+            def _locked_helper(self):
+                self.n += 1            # held at every call site
+    """)
+    assert run_checkers(root, [LockDisciplineChecker()]) == []
+
+
+def test_locks_cross_class_cycle_via_typed_attr(tmp_path):
+    _fixture_root(tmp_path, "etcd_tpu/store/store.py", """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self.world_lock = threading.Lock()
+                self.srv = None
+
+            def query(self):
+                with self.world_lock:
+                    self.srv.status()  # untyped: no edge back
+    """)
+    root = _fixture_root(
+        tmp_path, "etcd_tpu/server/server.py", """
+        import threading
+        from etcd_tpu.store.store import Store
+
+        class Server:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.store = Store()
+
+            def snapshot(self):
+                with self.lock:
+                    self.store.save()
+    """)
+    # add the reverse edge inside Store to complete the cycle
+    (tmp_path / "etcd_tpu/store/store.py").write_text(
+        textwrap.dedent("""
+        import threading
+
+        class Store:
+            def __init__(self):
+                self.world_lock = threading.Lock()
+                self.srv = Server()
+
+            def save(self):
+                with self.world_lock:
+                    return 1
+
+            def query(self):
+                with self.world_lock:
+                    self.srv.snapshot()
+
+        class Server:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.store = Store()
+
+            def snapshot(self):
+                with self.lock:
+                    self.store.save()
+        """))
+    findings = run_checkers(root, [LockDisciplineChecker()])
+    assert "lock-cycle" in _rules(findings)
+
+
+# -- 4. durability-ordering fires on seeded violations ------------------------
+
+
+def test_durability_fires_on_unsynced_write(tmp_path):
+    root = _fixture_root(tmp_path, "etcd_tpu/wal/wal.py", """
+        import os
+
+        class W:
+            def bad_save(self, data):
+                self.f.write(data)     # returns without fsync
+                return True
+
+            def bad_rename(self, a, b):
+                os.rename(a, b)        # dir entry never synced
+    """)
+    findings = run_checkers(root, [DurabilityOrderingChecker()])
+    scopes = {f.scope for f in findings
+              if f.rule == "unsynced-return"}
+    assert {"W.bad_save", "W.bad_rename"} <= scopes
+
+
+def test_durability_quiet_when_paths_sync(tmp_path):
+    root = _fixture_root(tmp_path, "etcd_tpu/wal/wal.py", """
+        import os
+
+        def fsync_dir(d):
+            fd = os.open(d, os.O_RDONLY)
+            os.fsync(fd)
+            os.close(fd)
+
+        class W:
+            def sync(self):
+                self.f.flush()
+                os.fsync(self.f.fileno())
+
+            def good_save(self, data):
+                self.f.write(data)
+                self.sync()
+                return True
+
+            def good_rename(self, a, b, d):
+                os.rename(a, b)
+                fsync_dir(d)
+
+            def error_path_ok(self, data):
+                self.f.write(data)
+                raise RuntimeError("no ack here")
+
+            def buffered(self, data):
+                self.f.write(data)     # the one accepted pattern...
+
+            def boundary(self, data):
+                self.buffered(data)    # ...is dirty for CALLERS
+                self.sync()
+                return True
+    """)
+    findings = run_checkers(root, [DurabilityOrderingChecker()])
+    scopes = {f.scope for f in findings}
+    # buffered() itself is flagged (baseline-able); every synced or
+    # raising path is clean, and the caller that syncs is clean
+    assert scopes == {"W.buffered"}
+
+
+# -- 5. error-vocabulary fires on seeded violations ---------------------------
+
+
+_VOCAB_FIXTURE_ERRORS = """
+    ECODE_KEY_NOT_FOUND = 100
+    ECODE_TEST_FAILED = 101
+
+    class EtcdError(Exception):
+        def __init__(self, code, cause=""):
+            self.error_code = code
+"""
+
+
+def test_errorvocab_fires_on_seeded_violations(tmp_path):
+    _fixture_root(tmp_path, "etcd_tpu/utils/errors.py",
+                  _VOCAB_FIXTURE_ERRORS)
+    root = _fixture_root(tmp_path, "etcd_tpu/store/store.py", """
+        from etcd_tpu.utils.errors import EtcdError
+
+        def a():
+            raise Exception("opaque")          # generic
+
+        def b():
+            raise EtcdError(999, "no such code")
+
+        def c():
+            raise EtcdError(ECODE_NOT_A_CODE, "undefined name")
+
+        class MadeUpError(Exception):
+            pass
+
+        def d():
+            raise MadeUpError("not allow-listed")
+    """)
+    findings = run_checkers(root, [ErrorVocabularyChecker()])
+    details = {f.detail for f in findings}
+    assert {"Exception", "999", "ECODE_NOT_A_CODE",
+            "MadeUpError"} <= details
+
+
+def test_errorvocab_quiet_on_vocabulary_and_allowlist(tmp_path):
+    _fixture_root(tmp_path, "etcd_tpu/utils/errors.py",
+                  _VOCAB_FIXTURE_ERRORS)
+    root = _fixture_root(tmp_path, "etcd_tpu/store/store.py", """
+        from etcd_tpu.utils.errors import EtcdError
+
+        def a(code):
+            raise EtcdError(ECODE_KEY_NOT_FOUND, "x")
+
+        def b():
+            raise EtcdError(101, "literal in vocab")
+
+        def c(code):
+            raise EtcdError(code, "runtime-resolved")
+
+        def d():
+            raise ValueError("allow-listed stdlib")
+
+        def e(resp):
+            raise resp.err
+
+        def f():
+            try:
+                raise ValueError()
+            except ValueError:
+                raise
+    """)
+    assert run_checkers(root, [ErrorVocabularyChecker()]) == []
+
+
+# -- 6. engine plumbing -------------------------------------------------------
+
+
+def test_inline_suppression_drops_finding(tmp_path):
+    root = _fixture_root(tmp_path, "etcd_tpu/wal/wal.py", """
+        class W:
+            def bad(self, data):
+                self.f.write(data)  # lint: ok(durability-ordering)
+    """)
+    assert run_checkers(root, [DurabilityOrderingChecker()]) == []
+
+
+@pytest.mark.parametrize("tail", [
+    "",                 # falls off the end
+    "        return 1\n",  # explicit return site
+])
+def test_fingerprints_survive_line_shifts(tmp_path, tail):
+    body = textwrap.dedent("""
+        class W:
+            def bad(self, data):
+                self.f.write(data)
+    """) + tail
+    (tmp_path / "etcd_tpu/wal").mkdir(parents=True, exist_ok=True)
+    (tmp_path / "etcd_tpu/wal/wal.py").write_text(body)
+    root = str(tmp_path)
+    (f1,) = run_checkers(root, [DurabilityOrderingChecker()])
+    shifted = "# moved\n# down\n# by comments\n" + body
+    (tmp_path / "etcd_tpu/wal/wal.py").write_text(shifted)
+    (f2,) = run_checkers(root, [DurabilityOrderingChecker()])
+    assert f1.fingerprint == f2.fingerprint
+    assert f1.line != f2.line
+    # the detail discriminates by mutating op, so a DIFFERENT future
+    # mutation in the same function is NOT masked by this baseline
+    assert "self.f.write" in f1.detail
+
+
+def test_scripts_lint_exits_zero_on_real_tree():
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "lint")],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

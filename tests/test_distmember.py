@@ -432,21 +432,33 @@ def test_randomized_lossy_exchange_log_matching(seed, m, steps):
                         assert pa == pb, (gi, idx, pa, pb)
 
 
-def test_timeout_bands_are_disjoint_across_slots():
+@pytest.mark.parametrize("election,m", [
+    (10, 3),    # the drill's config
+    (3, 8),     # small election / large m: clamps to election=8
+    (5, 5),     # boundary: exactly one tick of band per slot
+    (16, 4),    # wide bands
+])
+def test_timeout_bands_are_disjoint_across_slots(election, m):
     """Stratified election timeouts (distmember._draw_timeouts):
     every draw a slot can make lives in a per-slot tick band that is
     DISJOINT from every other slot's band, so two live hosts' timers
     can never fire in the same band — the structural fix for the
     drill's multi-round election tail (split votes between
-    survivors)."""
-    g, m, cap, election = 64, 3, 16, 10
+    survivors).  ``election < m`` cannot produce m disjoint bands in
+    [election, 2*election); DistMember clamps election up to m at
+    construction, so the documented <= 2*election worst case holds
+    on every config (the clamped election is the effective bound)."""
+    g, cap = 64, 16
+    eff = max(election, m)  # DistMember's construction clamp
     ranges = []
     for s in range(m):
         mm = DistMember(g, m, s, cap, election=election, seed=s)
+        assert mm.election == eff
         draws = np.concatenate(
             [mm._draw_timeouts() for _ in range(50)])
-        assert (draws >= election).all()
-        assert (draws < 2 * election).all()
+        assert (draws >= eff).all()
+        assert (draws < 2 * eff).all(), \
+            f"slot {s} draws beyond 2*election: {draws.max()}"
         ranges.append((int(draws.min()), int(draws.max())))
     for i in range(m):
         for j in range(i + 1, m):
