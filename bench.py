@@ -714,6 +714,26 @@ def run_extra_configs(extra: dict, backend: str,
                     checkpoint("dist_cluster", r)
             except Exception as e:
                 log(f"dist bench (g={g}) failed: {e!r}")
+        # small-window row (VERDICT r5 "Next round" #5): in_flight
+        # <= 64 keeps Little's-law queueing out of the latency, and
+        # the row carries the server-side ack-RTT histogram p50/p99
+        # (consensus RTT proper, stamped send -> quorum-ack by
+        # distserver's obs seam) alongside the client-observed ack
+        try:
+            r = _run_json_subbench(
+                "dist_bench.py",
+                [str(min(DIST_PROPOSALS, 4096)), "4", "16", "64"],
+                key="proposals_per_sec", timeout=600)
+            if r is not None:
+                log(f"dist[small-window]: in_flight="
+                    f"{r.get('in_flight')} at "
+                    f"{r['proposals_per_sec']}/s (consensus RTT p50 "
+                    f"{r.get('ack_rtt_consensus_p50_ms')}ms p99 "
+                    f"{r.get('ack_rtt_consensus_p99_ms')}ms)")
+                rows.append(r)
+                checkpoint("dist_cluster_small_window", r)
+        except Exception as e:
+            log(f"dist bench (small window) failed: {e!r}")
         if not rows:
             del extra["dist_cluster"]
 
@@ -914,56 +934,22 @@ def probe_env_ceiling(jax, dtype_name: str = "bf16") -> float | None:
     """Measured dense 2048^3 matmul throughput of this harness's
     device: TFLOPS for ``bf16``, TOPS for ``int8``.
 
-    Context for the primary metric: the axon-tunnel chip measures a
-    small fraction of the v5e spec (~197 bf16 TFLOPS / ~394 int8
-    TOPS), and that measured ceiling caps every MXU-based number in
-    this file — it is recorded in the JSON so the replay number can
-    be read against the hardware actually behind the tunnel.  The
-    probe runs a 64-deep device-resident train with one scalar
-    fetch: earlier 16-deep trains (~83 ms total at the observed
-    rates) were still dominated by the tunnel's fixed per-dispatch
-    latency, which is how round-4's artifact printed an impossible
-    408%-of-ceiling MFU.  The int8 row exists because the CRC
-    contraction IS an int8 matmul — the honest denominator for that
-    kernel's MFU.  One dtype per call so the caller can give each
-    probe its own stall budget (a hang in the second must not
-    discard the first's measurement).
+    The probe itself lives in obs/roofline.py (PR 2: ceiling
+    bookkeeping is the roofline module's job — the same probe backs
+    scripts/crc_variants_bench.py, so every MFU denominator in the
+    repo comes from one implementation).  Context for the primary
+    metric: the axon-tunnel chip measures a small fraction of the
+    v5e spec (~197 bf16 TFLOPS / ~394 int8 TOPS), and that measured
+    ceiling caps every MXU-based number in this file.  One dtype per
+    call so the caller can give each probe its own stall budget (a
+    hang in the second must not discard the first's measurement).
     """
-    import functools
+    from etcd_tpu.obs import roofline
 
-    import jax.numpy as jnp
-
-    k = 64
-    rng = np.random.default_rng(3)
-    dtype = jnp.bfloat16 if dtype_name == "bf16" else jnp.int8
-
-    @functools.partial(jax.jit, static_argnames=("k",))
-    def loop(a, b, k):
-        def body(i, acc):
-            r = jax.lax.dot_general(
-                a + i.astype(dtype), b,
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32
-                if dtype == jnp.bfloat16 else jnp.int32)
-            return acc + r[0, 0].astype(jnp.float32)
-
-        return jax.lax.fori_loop(0, k, body, jnp.float32(0))
-
-    try:
-        if dtype_name == "bf16":
-            a = jax.device_put(rng.standard_normal(
-                (2048, 2048)).astype(jnp.bfloat16))
-        else:
-            a = jax.device_put(rng.integers(
-                -4, 4, size=(2048, 2048)).astype(np.int8))
-        float(loop(a, a, k))  # compile (same static k as timed call)
-        t0 = time.perf_counter()
-        float(loop(a, a, k))
-        dt = time.perf_counter() - t0
-        return 2 * 2048**3 * k / dt / 1e12
-    except Exception as e:  # pragma: no cover - device-env specific
-        log(f"env ceiling probe ({dtype_name}) failed: {e!r}")
-        return None
+    r = roofline.probe_matmul_ceiling(jax, dtype_name)
+    if r is None:
+        log(f"env ceiling probe ({dtype_name}) failed")
+    return r
 
 
 def start_deadline_watchdog():
@@ -1265,34 +1251,27 @@ def main():
                 "axon loopback tunnel (~0.5 GB/s H2D, ~16 MB/s " \
                 "D2H, ~65 ms/dispatch — harness artifact)"
             tflops = extra.get("env_matmul_tflops_bf16")
-            # MFU-computable fields (VERDICT r4 #7; derivation in
-            # PALLAS_NOTES.md "MFU derivation"): the contraction is
-            # bits [N, 8W] @ C [8W, 32] -> 2*8W*32 = 512*W MACs per
-            # row, W = the padded row width of THIS batch
-            width = int(batch[0].shape[1])
-            fpe = 512 * width
-            extra["flops_per_entry"] = fpe
-            extra["row_width_bytes"] = width
-            extra["sustained_useful_tflops"] = round(
-                sus_eps * fpe / 1e12, 4)
-            if tflops:
-                # ceiling-normalized rate (VERDICT r3 #8): sustained
-                # ÷ this session's measured matmul ceiling, so
-                # cross-session numbers on the phase-swinging tunnel
-                # chip compare meaningfully
-                extra["entries_per_sec_per_tflop"] = round(
-                    sus_eps / tflops, 1)
-                # MFU against the ceiling the SAME session measured
-                # (the honest denominator on the phase-swinging
-                # tunnel chip; against v5e spec divide by 197 instead)
-                extra["pct_of_measured_ceiling"] = round(
-                    100.0 * sus_eps * fpe / 1e12 / tflops, 2)
             tops8 = extra.get("env_matmul_tops_int8")
-            if tops8:
-                # the contraction is an int8 matmul — this is the
-                # like-for-like MFU denominator
-                extra["pct_of_measured_ceiling_int8"] = round(
-                    100.0 * sus_eps * fpe / 1e12 / tops8, 2)
+            # MFU fields (VERDICT r4 #7 / r5 observability): EVERY
+            # derived field routes through obs/roofline.py — the
+            # generous (padded-matmul, 512*W) and honest (256-byte
+            # payload) FLOP definitions land side by side, and a
+            # >100%-of-ceiling fraction is tagged ceiling_suspect
+            # with the probe provenance instead of shipping as a
+            # clean row (the 408% artifact class, r5 weak #1)
+            from etcd_tpu.obs import roofline
+
+            width = int(batch[0].shape[1])
+            extra.update(roofline.mfu_fields(
+                sus_eps, width, payload_bytes=PAYLOAD,
+                measured_tflops_bf16=tflops,
+                measured_tops_int8=tops8,
+                provenance={
+                    "probe": "roofline.probe_matmul_ceiling "
+                             "(64-deep 2048^3 resident train)",
+                    "bf16_tflops": tflops, "int8_tops": tops8,
+                    "backend": backend,
+                    "probe_outcome": probe_info.get("outcome")}))
             _partial.update(value=value, vs=vs)
             checkpoint("sustained", {
                 "entries_per_sec": round(sus_eps, 1),

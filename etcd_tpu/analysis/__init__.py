@@ -19,6 +19,9 @@ that cost whole rounds and that the 6-minute suite cannot see:
 - **error-vocabulary** (errorvocab.py): every ``raise`` on the
   client-visible tier resolves to the numeric vocabulary in
   utils/errors.py or an allow-listed internal type.
+- **metrics-vocabulary** (metricsvocab.py): every obs-registry
+  accessor call uses a string-literal metric name registered in
+  obs/metrics.py's CATALOG — no ad-hoc metric keys (PR 2).
 
 ``scripts/lint`` runs the registry over the tree and gates on
 ``analysis_baseline.json`` (accepted legacy findings, each with a
@@ -38,6 +41,7 @@ from .engine import (
 )
 from .errorvocab import ErrorVocabularyChecker
 from .locks import LockDisciplineChecker
+from .metricsvocab import MetricsVocabularyChecker
 from .purity import TracerPurityChecker
 
 #: the registry scripts/lint and tests/test_analysis.py run
@@ -46,6 +50,7 @@ ALL_CHECKERS = (
     LockDisciplineChecker(),
     DurabilityOrderingChecker(),
     ErrorVocabularyChecker(),
+    MetricsVocabularyChecker(),
 )
 
 __all__ = [
@@ -55,6 +60,7 @@ __all__ = [
     "ErrorVocabularyChecker",
     "Finding",
     "LockDisciplineChecker",
+    "MetricsVocabularyChecker",
     "TracerPurityChecker",
     "load_baseline",
     "run_checkers",

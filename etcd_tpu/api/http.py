@@ -37,6 +37,7 @@ log = logging.getLogger(__name__)
 KEYS_PREFIX = "/v2/keys"
 MACHINES_PREFIX = "/v2/machines"
 STATS_PREFIX = "/v2/stats"
+METRICS_PREFIX = "/metrics"
 RAFT_PREFIX = "/raft"
 
 DEFAULT_SERVER_TIMEOUT = 0.5  # reference http.go:29
@@ -233,6 +234,8 @@ class EtcdRequestHandler(BaseHTTPRequestHandler):
                 return
             if path == MACHINES_PREFIX:
                 self._serve_machines(method)
+            elif path == METRICS_PREFIX:
+                self._serve_metrics(method)
             elif path.startswith(STATS_PREFIX):
                 self._serve_stats(method, path)
             elif path.startswith(KEYS_PREFIX):
@@ -347,6 +350,22 @@ class EtcdRequestHandler(BaseHTTPRequestHandler):
             return
         self._reply(200, body,
                     {"Content-Type": "application/json"})
+
+    def _serve_metrics(self, method: str) -> None:
+        """GET /metrics — Prometheus text exposition of the process
+        registry (PR 2 observability): wal fsync, apply batches,
+        elections, peer sends, ack-RTT, span histograms and the
+        device/host transfer ledger, all from obs/metrics.py's
+        catalog."""
+        if method != "GET":
+            self._reply(405, b"Method Not Allowed\n",
+                        {"Allow": "GET"})
+            return
+        from ..obs.exporter import CONTENT_TYPE, render_prometheus
+        from ..obs.metrics import registry
+
+        self._reply(200, render_prometheus(registry),
+                    {"Content-Type": CONTENT_TYPE})
 
     def _serve_machines(self, method: str) -> None:
         """Reference serveMachines (http.go:111-117)."""

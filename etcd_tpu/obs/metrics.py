@@ -1,0 +1,395 @@
+"""Typed process-wide metrics registry (the tentpole of SURVEY §5.1's
+first-class-tracing mandate, PR 2).
+
+Three instrument kinds behind one catalog:
+
+- **Counter**: monotone float add (``inc``).
+- **Gauge**: last-write-wins level (``set``/``inc``).
+- **Histogram**: fixed log-spaced bucket boundaries (Prometheus
+  ``le`` semantics) + a bounded ring of raw samples, so ``/metrics``
+  gets bucket counts while snapshot-time percentiles (p50/p90/p99/
+  p999) are EXACT over the ring window — percentile math never runs
+  on the record path, which is one short lock + an append
+  (utils/trace.py's design point, generalized).
+
+Every metric family must be declared in :data:`CATALOG` — the
+``metrics-vocabulary`` lint checker (analysis/metricsvocab.py) rejects
+``registry.counter("ad_hoc_name")`` calls whose name literal is not
+registered here, so the metric inventory in the README can never
+silently drift from the code.
+
+This module is stdlib-only by design: the analysis package imports it
+for the catalog, and the WAL/server tiers import it on their hot
+paths — neither may pull jax/numpy in.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from bisect import bisect_left
+from collections import deque
+from dataclasses import dataclass
+
+#: default latency boundaries (seconds), log-spaced 100 µs → 10 s
+LATENCY_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+#: size/count boundaries, powers of two 1 → 8192
+SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048,
+                4096, 8192)
+
+#: chaos-drill recovery boundaries — the series tops out well above
+#: the latency default's 10 s when a window never recovers
+RECOVERY_BUCKETS = (0.25, 0.5, 1.0, 1.5, 2.0, 2.5, 3.0, 3.5, 4.0,
+                    4.5, 5.0, 5.5, 6.0, 8.0, 10.0, 15.0, 30.0)
+
+
+@dataclass(frozen=True)
+class MetricDef:
+    """One registered metric family."""
+
+    name: str
+    kind: str                    # "counter" | "gauge" | "histogram"
+    help: str
+    labels: tuple[str, ...] = ()
+    buckets: tuple[float, ...] = LATENCY_BUCKETS
+    window: int = 1024           # histogram ring size (exact pctls)
+
+
+# The metric inventory.  Names follow Prometheus conventions
+# (unit-suffixed, ``_total`` for counters); the README "Observability"
+# section mirrors this table.
+_DEFS = (
+    MetricDef(
+        "etcd_span_seconds", "histogram",
+        "Host span latency by span name (Tracer facade; the "
+        "/v2/stats/spans source).", labels=("span",), window=256),
+    MetricDef(
+        "etcd_wal_fsync_seconds", "histogram",
+        "WAL flush+fsync latency per sync() (the Ready-contract "
+        "durability step)."),
+    MetricDef(
+        "etcd_wal_append_entries_total", "counter",
+        "WAL entry records appended via save()."),
+    MetricDef(
+        "etcd_wal_cuts_total", "counter",
+        "WAL segment cuts."),
+    MetricDef(
+        "etcd_apply_seconds", "histogram",
+        "Apply-loop latency per absorbed commit batch."),
+    MetricDef(
+        "etcd_apply_batch_entries", "histogram",
+        "Entries applied per apply-loop batch.",
+        buckets=SIZE_BUCKETS),
+    MetricDef(
+        "etcd_election_campaigns_total", "counter",
+        "Per-group election campaign lanes fired."),
+    MetricDef(
+        "etcd_election_wins_total", "counter",
+        "Per-group election lanes won."),
+    MetricDef(
+        "etcd_peer_send_frames_total", "counter",
+        "Peer frames POSTed (path: classic one-group sender | dist "
+        "batched [G] frames).", labels=("path",)),
+    MetricDef(
+        "etcd_peer_send_seconds", "histogram",
+        "Peer POST round-trip latency.", labels=("path",)),
+    MetricDef(
+        "etcd_peer_send_failures_total", "counter",
+        "Peer frames dropped after retries.", labels=("path",)),
+    MetricDef(
+        "etcd_ack_rtt_seconds", "histogram",
+        "Dist-tier consensus RTT per proposal: leader append/send "
+        "-> quorum ack -> local apply.  Stamped at SEND, so client "
+        "queueing cannot pollute it (the majority-RTT model of "
+        "optimal-cluster-size.md).", window=4096),
+    MetricDef(
+        "etcd_pending_proposals", "gauge",
+        "Requeued proposals awaiting a leader or window space."),
+    MetricDef(
+        "etcd_devledger_dispatches_total", "counter",
+        "Device dispatches crossing a jitted seam, per stage.",
+        labels=("stage",)),
+    MetricDef(
+        "etcd_devledger_dispatch_seconds_total", "counter",
+        "Wall seconds inside dispatch seams, per stage.",
+        labels=("stage",)),
+    MetricDef(
+        "etcd_devledger_block_seconds_total", "counter",
+        "Wall seconds blocked on device results "
+        "(block_until_ready / host materialization), per stage.",
+        labels=("stage",)),
+    MetricDef(
+        "etcd_devledger_h2d_bytes_total", "counter",
+        "Host->device bytes shipped per stage.", labels=("stage",)),
+    MetricDef(
+        "etcd_devledger_d2h_bytes_total", "counter",
+        "Device->host bytes fetched per stage.", labels=("stage",)),
+    MetricDef(
+        "etcd_chaos_cycle_recovery_seconds", "histogram",
+        "Chaos-drill kill -> all-groups-writable recovery per "
+        "cycle.", buckets=RECOVERY_BUCKETS),
+)
+
+#: name -> MetricDef; THE metric vocabulary (lint-enforced)
+CATALOG: dict[str, MetricDef] = {d.name: d for d in _DEFS}
+
+
+class Counter:
+    __slots__ = ("_lock", "value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+
+    def get(self) -> float:
+        with self._lock:
+            return self.value
+
+
+class Gauge:
+    __slots__ = ("_lock", "value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+
+    def get(self) -> float:
+        with self._lock:
+            return self.value
+
+
+class Histogram:
+    """Log-bucketed histogram + bounded raw-sample ring.
+
+    ``observe`` is one lock, one bisect, one append.  Percentiles are
+    computed at snapshot time over the ring with the index rule
+    ``sorted[min(n-1, int(n*q))]`` — the exact rule utils/trace.py
+    has always used, so the Tracer facade's output stays byte-stable.
+    """
+
+    __slots__ = ("_lock", "bounds", "buckets", "count", "sum",
+                 "max", "_ring")
+
+    def __init__(self, bounds: tuple[float, ...],
+                 window: int = 1024):
+        self._lock = threading.Lock()
+        self.bounds = tuple(float(b) for b in bounds)
+        self.buckets = [0] * (len(self.bounds) + 1)  # +1: +Inf
+        self.count = 0
+        self.sum = 0.0
+        self.max = 0.0
+        self._ring: deque[float] = deque(maxlen=window)
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            if v > self.max:
+                self.max = v
+            self.buckets[bisect_left(self.bounds, v)] += 1
+            self._ring.append(v)
+
+    def ring_stats(self) -> tuple[int, float, float, list[float]]:
+        """(count, sum, max, sorted ring) — one consistent read."""
+        with self._lock:
+            return self.count, self.sum, self.max, sorted(self._ring)
+
+    def percentile(self, q: float) -> float:
+        _, _, _, ring = self.ring_stats()
+        if not ring:
+            return 0.0
+        return ring[min(len(ring) - 1, int(len(ring) * q))]
+
+    def snapshot(self) -> dict:
+        # ONE critical section: buckets copied with count/sum/ring so
+        # the +Inf cumulative always equals _count (the Prometheus
+        # invariant a concurrent observe() between two lock takes
+        # would break)
+        with self._lock:
+            count, total, mx = self.count, self.sum, self.max
+            ring = sorted(self._ring)
+            buckets = list(self.buckets)
+        out = {"count": count, "sum": total, "max": mx,
+               "bounds": list(self.bounds), "buckets": buckets}
+        for key, q in (("p50", 0.5), ("p90", 0.9), ("p99", 0.99),
+                       ("p999", 0.999)):
+            out[key] = (ring[min(len(ring) - 1, int(len(ring) * q))]
+                        if ring else 0.0)
+        return out
+
+
+_KIND_CLASS = {"counter": Counter, "gauge": Gauge}
+
+
+class _Family:
+    """One metric family: the def plus its labeled children."""
+
+    def __init__(self, d: MetricDef):
+        self.d = d
+        self._lock = threading.Lock()
+        self._children: dict[tuple[str, ...], object] = {}
+
+    def child(self, labelvalues: tuple[str, ...]):
+        with self._lock:
+            c = self._children.get(labelvalues)
+            if c is None:
+                if self.d.kind == "histogram":
+                    c = Histogram(self.d.buckets, self.d.window)
+                else:
+                    c = _KIND_CLASS[self.d.kind]()
+                self._children[labelvalues] = c
+            return c
+
+    def children(self) -> list[tuple[tuple[str, ...], object]]:
+        with self._lock:
+            return sorted(self._children.items())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._children.clear()
+
+
+class Registry:
+    """Catalog-checked accessors + whole-registry snapshots.
+
+    Accessors raise ``KeyError`` for names missing from the catalog
+    and ``TypeError`` for kind or label-key mismatches — a typo'd
+    metric fails loudly at first record, never as a silent new
+    family.
+    """
+
+    def __init__(self, catalog: dict[str, MetricDef] | None = None):
+        self._catalog = dict(catalog if catalog is not None
+                             else CATALOG)
+        self._fams = {name: _Family(d)
+                      for name, d in self._catalog.items()}
+
+    def _child(self, name: str, kind: str, labels: dict):
+        fam = self._fams.get(name)
+        if fam is None:
+            raise KeyError(
+                f"metric {name!r} is not in the catalog "
+                f"(register it in obs/metrics.py CATALOG)")
+        if fam.d.kind != kind:
+            raise TypeError(
+                f"metric {name!r} is a {fam.d.kind}, not a {kind}")
+        if tuple(sorted(labels)) != tuple(sorted(fam.d.labels)):
+            raise TypeError(
+                f"metric {name!r} takes labels {fam.d.labels}, "
+                f"got {tuple(sorted(labels))}")
+        return fam.child(tuple(str(labels[k])
+                               for k in fam.d.labels))
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._child(name, "counter", labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._child(name, "gauge", labels)
+
+    def histogram(self, name: str, **labels) -> Histogram:
+        return self._child(name, "histogram", labels)
+
+    def family(self, name: str) -> _Family:
+        return self._fams[name]
+
+    def families(self) -> list[_Family]:
+        return [self._fams[n] for n in sorted(self._fams)]
+
+    def snapshot(self) -> dict:
+        """JSON-ready view: every family, its kind/help, and one
+        entry per labeled child (histograms carry bucket counts AND
+        exact ring percentiles — the /mraft/obs and soak-artifact
+        form)."""
+        out = {}
+        for fam in self.families():
+            samples = []
+            for labelvalues, child in fam.children():
+                entry = {"labels": dict(zip(fam.d.labels,
+                                            labelvalues))}
+                if fam.d.kind == "histogram":
+                    entry.update(child.snapshot())
+                else:
+                    entry["value"] = child.get()
+                samples.append(entry)
+            out[fam.d.name] = {"kind": fam.d.kind,
+                               "help": fam.d.help,
+                               "samples": samples}
+        return out
+
+    def snapshot_json(self) -> bytes:
+        return (json.dumps(self.snapshot(), sort_keys=True)
+                + "\n").encode()
+
+    def reset(self) -> None:
+        """Drop every recorded sample (tests / process reuse)."""
+        for fam in self._fams.values():
+            fam.clear()
+
+
+#: the process-wide default registry — servers, WAL, benches and the
+#: /metrics exporter all record here
+registry = Registry()
+
+
+def percentile_from_buckets(bounds: list[float], buckets: list[int],
+                            q: float) -> float:
+    """Upper-bound percentile estimate from (possibly merged) bucket
+    counts — the cross-process form (scripts/dist_bench.py merges the
+    three hosts' ack-RTT buckets through this).  Returns the ``le``
+    boundary of the bucket holding quantile ``q``; the overflow
+    bucket reports the last finite boundary (a floor, flagged by the
+    caller if it matters)."""
+    total = sum(buckets)
+    if total == 0:
+        return 0.0
+    target = q * total
+    cum = 0
+    for i, c in enumerate(buckets):
+        cum += c
+        if cum >= target:
+            return bounds[i] if i < len(bounds) else bounds[-1]
+    return bounds[-1]
+
+
+def merge_histograms(samples: list[dict]) -> dict | None:
+    """Merge JSON-snapshot histogram entries (same bounds) into one
+    {bounds, buckets, count, sum} dict; None when empty/mismatched."""
+    samples = [s for s in samples if s and s.get("count")]
+    if not samples:
+        return None
+    bounds = samples[0]["bounds"]
+    if any(s["bounds"] != bounds for s in samples):
+        return None
+    buckets = [0] * (len(bounds) + 1)
+    count = 0
+    total = 0.0
+    for s in samples:
+        for i, c in enumerate(s["buckets"]):
+            buckets[i] += c
+        count += s["count"]
+        total += s["sum"]
+    return {"bounds": bounds, "buckets": buckets, "count": count,
+            "sum": total}
+
+
+__all__ = [
+    "CATALOG", "LATENCY_BUCKETS", "RECOVERY_BUCKETS", "SIZE_BUCKETS",
+    "Counter", "Gauge", "Histogram", "MetricDef", "Registry",
+    "merge_histograms", "percentile_from_buckets", "registry",
+]

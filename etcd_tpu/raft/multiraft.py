@@ -36,6 +36,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..obs.devledger import ledger as _ledger
 from .batched import (
     CANDIDATE,
     FOLLOWER,
@@ -469,10 +470,13 @@ class MultiRaft:
         mask = np.ones(g, bool) if mask is None else np.asarray(mask, bool)
         dense = self._no_drop if not drop else \
             self._put_drop(_drop_dense(drop, self.m, g))
-        states, won = _fused_campaign(
-            tuple(self.states), self._put_g(mask), dense, slot=slot)
+        _ledger.h2d("multiraft.campaign", mask)
+        with _ledger.dispatch("multiraft.campaign"):
+            states, won = _fused_campaign(
+                tuple(self.states), self._put_g(mask), dense,
+                slot=slot)
         self.states = list(states)
-        won_np = np.asarray(won)
+        won_np = _ledger.fetch("multiraft.campaign", won)
         self.leader = np.where(won_np, slot, self.leader).astype(np.int32)
         self._recompute_hot()
         if won_np.any():
@@ -504,17 +508,20 @@ class MultiRaft:
         n_new = np.asarray(n_new, np.int32)
         dense = self._no_drop if not drop else \
             self._put_drop(_drop_dense(drop, self.m, g))
-        if self._route_hot is not None:
-            hot = self._route_hot
-            states, newly, valid, base, overflow, conflict = \
-                _fused_round_hot(
-                    tuple(self.states), self._hot_sel_dev(hot),
-                    self._put_g(n_new), dense, e=self.e, slot=hot)
-        else:
-            states, newly, valid, base, overflow, conflict = \
-                _fused_round(
-                    tuple(self.states), self._put_g(self.leader),
-                    self._put_g(n_new), dense, e=self.e)
+        _ledger.h2d("multiraft.round", n_new)
+        with _ledger.dispatch("multiraft.round"):
+            if self._route_hot is not None:
+                hot = self._route_hot
+                states, newly, valid, base, overflow, conflict = \
+                    _fused_round_hot(
+                        tuple(self.states), self._hot_sel_dev(hot),
+                        self._put_g(n_new), dense, e=self.e,
+                        slot=hot)
+            else:
+                states, newly, valid, base, overflow, conflict = \
+                    _fused_round(
+                        tuple(self.states), self._put_g(self.leader),
+                        self._put_g(n_new), dense, e=self.e)
         self.states = list(states)
         # lazy device arrays, same as propose_rounds: consumers call
         # .any()/np.asarray when (if) they actually look
@@ -532,7 +539,7 @@ class MultiRaft:
                 for j, blob in enumerate(data[gi][:int(n_new[gi])]):
                     self.payloads[gi][int(self.last_base[gi]) + 1 + j] \
                         = blob
-        return np.asarray(newly)
+        return _ledger.fetch("multiraft.round", newly)
 
     def propose_rounds(self, n_new: np.ndarray, rounds: int,
                        drop=None) -> np.ndarray:
@@ -550,24 +557,28 @@ class MultiRaft:
         g = self.g
         dense = self._no_drop if not drop else \
             self._put_drop(_drop_dense(drop, self.m, g))
-        if self._route_hot is not None:
-            hot = self._route_hot
-            states, newly, overflow, conflict = _fused_multi_round_hot(
-                tuple(self.states), self._hot_sel_dev(hot),
-                self._put_g(n_new, np.int32), dense,
-                e=self.e, k=rounds, slot=hot)
-        else:
-            states, newly, overflow, conflict = _fused_multi_round(
-                tuple(self.states), self._put_g(self.leader),
-                self._put_g(n_new, np.int32), dense,
-                e=self.e, k=rounds)
+        _ledger.h2d("multiraft.train", np.asarray(n_new, np.int32))
+        with _ledger.dispatch("multiraft.train"):
+            if self._route_hot is not None:
+                hot = self._route_hot
+                states, newly, overflow, conflict = \
+                    _fused_multi_round_hot(
+                        tuple(self.states), self._hot_sel_dev(hot),
+                        self._put_g(n_new, np.int32), dense,
+                        e=self.e, k=rounds, slot=hot)
+            else:
+                states, newly, overflow, conflict = \
+                    _fused_multi_round(
+                        tuple(self.states), self._put_g(self.leader),
+                        self._put_g(n_new, np.int32), dense,
+                        e=self.e, k=rounds)
         self.states = list(states)
         # device arrays, materialized lazily by consumers (np.asarray
         # / .any() work transparently) — two eager [G] gathers per
         # dispatch were measurable serving overhead on the mesh
         self.errors["overflow"] = overflow
         self.errors["conflict"] = conflict
-        return np.asarray(newly)
+        return _ledger.fetch("multiraft.train", newly)
 
     def replicate(self, drop=None) -> np.ndarray:
         """One replication round for every group: leaders send their

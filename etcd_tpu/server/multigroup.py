@@ -45,6 +45,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..obs import metrics as _obs
 from ..snap import NoSnapshotError, Snapshotter
 from ..store import Store
 from ..utils.trace import maybe_start_jax_profile, tracer
@@ -66,6 +67,12 @@ from .stats import LeaderStats, ServerStats
 log = logging.getLogger(__name__)
 
 TICK_INTERVAL = 0.1        # reference server.go:182
+
+# obs seams (PR 2): apply-loop shape + election churn, process-wide
+_M_APPLY_S = _obs.registry.histogram("etcd_apply_seconds")
+_M_APPLY_N = _obs.registry.histogram("etcd_apply_batch_entries")
+_M_CAMPAIGNS = _obs.registry.counter("etcd_election_campaigns_total")
+_M_WINS = _obs.registry.counter("etcd_election_wins_total")
 
 
 def group_of(path: str, g: int) -> int:
@@ -359,7 +366,10 @@ class MultiGroupServer:
         mr = self.mr
         slot = self._campaign_slot
         self._campaign_slot = (slot + 1) % self.m
-        won = mr.campaign(slot, mask=np.asarray(mask, bool))
+        mask_np = np.asarray(mask, bool)
+        won = mr.campaign(slot, mask=mask_np)
+        _M_CAMPAIGNS.inc(int(mask_np.sum()))
+        _M_WINS.inc(int(won.sum()))
         fences: list[Entry] = []
         if won.any():
             base = mr.last_base
@@ -647,8 +657,12 @@ class MultiGroupServer:
 
         if not newly.any():
             return
+        n_apply = int((commit - self.applied)[newly].sum())
+        t0 = time.perf_counter()
         with tracer.span("mg.apply"):
             self._apply_newly(assigned, commit, newly)
+        _M_APPLY_N.observe(n_apply)
+        _M_APPLY_S.observe(time.perf_counter() - t0)
         mr.mark_applied(self.applied)
 
         if self.raft_index - self._snapi > self.snap_count:

@@ -20,10 +20,20 @@ import urllib.error
 import urllib.request
 from typing import Callable
 
+from ..obs import metrics as _obs
 from ..wire import MSG_APP, Message
 from .cluster import RAFT_PREFIX, ClusterStore
 
 log = logging.getLogger(__name__)
+
+# obs seams (PR 2): every POST attempt is a frame; RTT on success,
+# a failure only after the retry budget is spent
+_M_FRAMES = _obs.registry.counter("etcd_peer_send_frames_total",
+                                  path="classic")
+_M_RTT = _obs.registry.histogram("etcd_peer_send_seconds",
+                                 path="classic")
+_M_FAILS = _obs.registry.counter("etcd_peer_send_failures_total",
+                                 path="classic")
 
 
 def default_post(url: str, data: bytes, timeout: float = 1.0,
@@ -82,11 +92,16 @@ def _send_one(cls: ClusterStore, m: Message, post, stats=None) -> None:
             log.warning("etcdhttp: no addr for %x", m.to)
             if track:  # unreachable == failed, for /v2/stats/leader
                 stats.fail(m.to)
+            _M_FAILS.inc()
             return
         t0 = time.perf_counter()
+        _M_FRAMES.inc()
         if post(u + RAFT_PREFIX, data):
+            dt = time.perf_counter() - t0
+            _M_RTT.observe(dt)
             if track:
-                stats.observe(m.to, time.perf_counter() - t0)
+                stats.observe(m.to, dt)
             return
+    _M_FAILS.inc()
     if track:
         stats.fail(m.to)

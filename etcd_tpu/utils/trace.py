@@ -2,15 +2,20 @@
 
 New work mandated by SURVEY §5.1: the reference has nothing beyond
 ``log.Printf`` at state transitions (raft/node.go:208) and no pprof
-endpoint in this snapshot.  Here every hot seam (WAL persist, replay,
+endpoint in this snapshot.  Every hot seam (WAL persist, replay,
 consensus round, apply, snapshot) runs under a named span; aggregated
 latency stats (count/mean/p50/p99/max over a sliding window) are
 exported via ``/v2/stats/spans`` and a JAX device-profile capture can
 be armed with ``ETCD_TRACE_DIR=/path`` (written via
 ``jax.profiler.start_trace`` for xprof/tensorboard).
 
-Design: recording is a lock + deque append (no allocation on the hot
-path beyond the float); percentile math runs only at snapshot time.
+Since PR 2 the Tracer is a thin FACADE over the obs metrics registry:
+``record`` lands in the ``etcd_span_seconds`` histogram family
+(window 256, the same ring the old deque implementation kept), so
+spans also appear in ``GET /metrics`` bucket form for free.  The
+``/v2/stats/spans`` output is byte-stable against the pre-facade
+implementation — same keys, same percentile index rule
+(``sorted[min(n-1, int(n*q))]``), same rounding.
 """
 
 from __future__ import annotations
@@ -18,13 +23,17 @@ from __future__ import annotations
 import json
 import logging
 import os
-import threading
 import time
-from collections import deque
+
+from ..obs import metrics as _metrics
 
 log = logging.getLogger(__name__)
 
-_WINDOW = 256  # sliding window per span for percentile estimates
+_SPAN_FAMILY = "etcd_span_seconds"  # catalog family backing spans
+
+#: sliding window per span — governed by the catalog entry, surfaced
+#: here for readers of the old constant
+_WINDOW = _metrics.CATALOG[_SPAN_FAMILY].window
 
 
 class _Span:
@@ -44,31 +53,36 @@ class _Span:
 
 
 class Tracer:
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._stats: dict[str, list] = {}  # name -> [count, total, max, ring]
+    """Span recorder over a metrics registry's span family.
+
+    A bare ``Tracer()`` owns a private registry (test isolation);
+    the module-level :data:`tracer` records into the process-wide
+    default registry so spans ride ``/metrics`` too.
+    """
+
+    def __init__(self, registry: _metrics.Registry | None = None):
+        self._reg = (registry if registry is not None
+                     else _metrics.Registry())
+        # per-name child cache: the record path stays one dict get +
+        # the histogram lock (catalog/label validation only on first
+        # use) — the old deque implementation's cost profile
+        self._hists: dict[str, _metrics.Histogram] = {}
 
     def span(self, name: str) -> _Span:
         return _Span(self, name)
 
     def record(self, name: str, dt: float) -> None:
-        with self._lock:
-            s = self._stats.get(name)
-            if s is None:
-                s = [0, 0.0, 0.0, deque(maxlen=_WINDOW)]
-                self._stats[name] = s
-            s[0] += 1
-            s[1] += dt
-            if dt > s[2]:
-                s[2] = dt
-            s[3].append(dt)
+        h = self._hists.get(name)
+        if h is None:
+            h = self._hists[name] = self._reg.histogram(
+                "etcd_span_seconds", span=name)
+        h.observe(dt)
 
     def snapshot(self) -> dict:
         out = {}
-        with self._lock:
-            items = [(k, v[0], v[1], v[2], sorted(v[3]))
-                     for k, v in self._stats.items()]
-        for name, count, total, mx, ring in items:
+        for (name,), hist in self._reg.family(
+                _SPAN_FAMILY).children():
+            count, total, mx, ring = hist.ring_stats()
             if not ring:
                 continue
             p50 = ring[len(ring) // 2]
@@ -88,12 +102,16 @@ class Tracer:
                 "\n").encode()
 
     def reset(self) -> None:
-        with self._lock:
-            self._stats.clear()
+        # the cache must drop with the family's children: a cached
+        # handle to a cleared child would record into an orphan the
+        # snapshot path no longer sees
+        self._hists = {}
+        self._reg.family(_SPAN_FAMILY).clear()
 
 
 #: process-wide default tracer — servers and replay paths record here
-tracer = Tracer()
+#: (into the default obs registry, so spans surface on /metrics too)
+tracer = Tracer(_metrics.registry)
 
 _profiling = False
 

@@ -32,6 +32,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from .. import native
+from ..obs.devledger import ledger as _ledger
 from ..wire import Entry, HardState
 from ..wire.proto import ProtoError
 from .errors import (
@@ -273,9 +274,15 @@ def verify_chain_device(blob: np.ndarray, types, crcs, doff, dlen,
                 rows = native.pad_rows(blob, d_off, d_len, w)
             else:
                 rows = _pad_rows_numpy(blob, d_off, d_len, w)
-            raw = raw_crc_batch(rows)
-            ok = np.asarray(
-                _chain_expected(pv, raw, d_len.astype(np.uint32)) == st)
+            # devledger seam: the padded batch is the H2D shipment,
+            # the [rows] ok mask the D2H readback — per-chunk cost of
+            # the replay lane, readable off /metrics after a restart
+            _ledger.h2d("replay.verify", rows)
+            with _ledger.dispatch("replay.verify"):
+                ok = np.asarray(
+                    _chain_expected(pv, raw_crc_batch(rows),
+                                    d_len.astype(np.uint32)) == st)
+            _ledger.d2h("replay.verify", ok)
             if not ok.all():
                 bad = start + int(sel[np.argmin(ok[:sel.size])])
                 if first_bad is None or bad < first_bad:

@@ -22,9 +22,11 @@ from __future__ import annotations
 import logging
 import os
 import struct
+import time
 from typing import BinaryIO
 
 from ..crc import Digest
+from ..obs import metrics as _obs
 from ..utils.fsio import fsync_dir
 from ..wire import Entry, HardState, Record
 from .errors import (
@@ -46,6 +48,12 @@ CRC_TYPE = 4
 
 _PRIVATE_DIR_MODE = 0o700
 _LEN_STRUCT = struct.Struct("<q")
+
+# obs seams (PR 2): fsync latency is THE durability hot metric — every
+# client ack sits behind one of these (the Ready contract)
+_FSYNC_HIST = _obs.registry.histogram("etcd_wal_fsync_seconds")
+_APPEND_CTR = _obs.registry.counter("etcd_wal_append_entries_total")
+_CUT_CTR = _obs.registry.counter("etcd_wal_cuts_total")
 
 
 def wal_name(seq: int, index: int) -> str:
@@ -480,11 +488,14 @@ class WAL:
         # before the next save() must leave an openable chain
         self.sync()
         fsync_dir(self.dir)
+        _CUT_CTR.inc()
 
     def sync(self) -> None:
         if self.f is not None:
+            t0 = time.perf_counter()
             self.f.flush()
             os.fsync(self.f.fileno())
+            _FSYNC_HIST.observe(time.perf_counter() - t0)
 
     def close(self) -> None:
         if self.decoder is not None:
@@ -520,6 +531,8 @@ class WAL:
         self.save_state(st)
         for e in ents:
             self.save_entry(e)
+        if ents:
+            _APPEND_CTR.inc(len(ents))
         self.sync()
 
     def _save_crc(self, prev_crc: int) -> None:

@@ -107,7 +107,13 @@ def put_batch(slot, items, timeout=20):
 # must run BEFORE the servers spawn: a failure here would skip the
 # try/finally and orphan three server processes on the shared core.
 sys.path.insert(0, REPO)
+from etcd_tpu.obs.metrics import registry as obs_registry  # noqa: E402
 from etcd_tpu.server.multigroup import group_of  # noqa: E402
+
+# the drill's cycle-latency series rides the obs histogram (exact
+# ring percentiles at gate time; same instrument the servers use)
+recovery_hist = obs_registry.histogram(
+    "etcd_chaos_cycle_recovery_seconds")
 
 N_GROUPS = 4
 # namespaces (the first path segment is what group_of hashes) chosen
@@ -284,6 +290,7 @@ try:
             # a group never recovered inside the window — record the
             # full window as a (pessimistic) lower bound
             recovery.append(time.time() - t_kill)
+        recovery_hist.observe(recovery[-1])
         # kill->writable decomposition (VERDICT r4 #3): for every
         # group that re-elected after the kill, split the
         # client-observed window into election delay (kill -> a
@@ -408,21 +415,26 @@ try:
                           f"{type(e).__name__}", flush=True)
         assert caught, f"s{victim} failed to catch up"
     assert not lost, lost
-    rec = sorted(recovery)
-    p50 = rec[len(rec) // 2]
-    p99 = rec[min(len(rec) - 1, int(len(rec) * 0.99))]
-    # Liveness gate: worst-case election timeout = 2*election ticks
-    # (distmember init: timeout in [election, 2*election)); with the
-    # CLI defaults (election=10 ticks x 0.1s tick) that is 2s, 2x = 4s
-    # + 3s probe-timeout resolution slack.  Pre-fix windows were ~12s.
-    # Batch mode saturates the single shared core (4 python processes
-    # + the pipelined client), inflating one-off election round-trips;
-    # it gets 2s of extra contention slack (observed post-fix
+    p50 = recovery_hist.percentile(0.5)
+    p90 = recovery_hist.percentile(0.9)
+    p99 = recovery_hist.percentile(0.99)
+    # Liveness gate (tightened, VERDICT r5 "Next round" #7): worst-
+    # case election timeout = 2*election ticks (distmember init:
+    # timeout in [election, 2*election)); with the CLI defaults
+    # (election=10 ticks x 0.1s tick) that is 2s.  Classic gate:
+    # p90 < 4s (2x worst-case timeout) AND p99 < 5.5s (+1.5s of the
+    # drill's sequential 3s-timeout probe resolution).  Pre-fix
+    # windows were ~12s.  Contention calibration: batch mode
+    # saturates the single shared core (4 python processes + the
+    # pipelined client), inflating one-off election round-trips —
+    # its bounds carry ~1-1.5s extra slack (observed post-fix
     # distribution: p50 ~2s, next-worst ~3.6s, rare outlier ~8s —
-    # nothing like the pre-fix 12-15s wedge signatures).
-    bound = 9.0 if batch_mode else 7.0
-    print(f"recovery: p50 {p50:.2f}s p99 {p99:.2f}s "
-          f"(bound {bound}s, n={len(rec)})", flush=True)
+    # nothing like the pre-fix 12-15s wedge signatures), but they
+    # too are tighter than the old 9s gate.
+    bound90, bound99 = (5.0, 7.0) if batch_mode else (4.0, 5.5)
+    print(f"recovery: p50 {p50:.2f}s p90 {p90:.2f}s p99 {p99:.2f}s "
+          f"(bounds p90<{bound90}s p99<{bound99}s, "
+          f"n={len(recovery)})", flush=True)
 
     # span table: where the client-observed window actually goes
     def pctl(xs, q):
@@ -454,9 +466,13 @@ try:
               flush=True)
     print(json.dumps({"recovery_decomp": decomp,
                       "unaffected": [round(x, 3)
-                                     for x in unaffected]}),
+                                     for x in unaffected],
+                      "recovery_hist": recovery_hist.snapshot()}),
           flush=True)
-    assert p99 < bound, f"p99 leader recovery {p99:.2f}s >= {bound}s"
+    assert p90 < bound90, \
+        f"p90 leader recovery {p90:.2f}s >= {bound90}s"
+    assert p99 < bound99, \
+        f"p99 leader recovery {p99:.2f}s >= {bound99}s"
     # The round-3 liveness criterion, asserted on the metric it was
     # actually about: the SERVER-side kill->writable window (the
     # client-observed number additionally pays the drill's

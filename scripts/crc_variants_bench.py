@@ -44,6 +44,7 @@ def main() -> None:
     import jax.numpy as jnp
 
     from etcd_tpu.crc import crc32c
+    from etcd_tpu.obs import roofline
     from etcd_tpu.ops.crc_device import (
         _raw_crc_jit,
         chain_links_injected,
@@ -53,6 +54,18 @@ def main() -> None:
     from etcd_tpu.ops.crc_variants import VARIANTS, plane_matrices
 
     backend = jax.default_backend()
+
+    # Measured MFU denominator for the per-variant roofline fields
+    # (obs/roofline.py is the single source of truth for every
+    # MFU/entries-per-TFLOP derivation — PR 2).  The probe costs a
+    # ~1.1 TFLOP train: free on a chip, minutes on the 1-core CPU
+    # box, so CPU runs skip it unless explicitly asked.
+    ceiling_bf16 = None
+    if backend == "tpu" or os.environ.get("BENCH_PROBE_CEILING"):
+        ceiling_bf16 = roofline.probe_matmul_ceiling(jax, "bf16")
+        print(json.dumps({"env_matmul_tflops_bf16":
+                          round(ceiling_bf16, 2)
+                          if ceiling_bf16 else None}), flush=True)
 
     # synthetic right-aligned chained records (seed-injected, so every
     # variant's gate is the full rolling-chain verify).  Generation is
@@ -196,6 +209,15 @@ def main() -> None:
             results[name] = {"entries_per_sec": round(eps, 1),
                              "gbps": round(gbps, 3),
                              "compile_s": round(compile_s, 2)}
+            # roofline-derived fields (generous + honest FLOP
+            # definitions; ceiling_suspect tagging on impossible
+            # fractions) — same derivation path as bench.py's
+            results[name].update(roofline.mfu_fields(
+                eps, width,
+                measured_tflops_bf16=ceiling_bf16,
+                provenance={"probe": "roofline.probe_matmul_ceiling",
+                            "bf16_tflops": ceiling_bf16,
+                            "backend": backend}))
             print(json.dumps({"variant": name, "backend": backend,
                               **results[name]}), flush=True)
         except Exception as e:  # per-variant isolation

@@ -37,9 +37,14 @@ import sys
 import tempfile
 import threading
 import time
+import urllib.request
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+from etcd_tpu.obs.metrics import (  # noqa: E402
+    merge_histograms,
+    percentile_from_buckets,
+)
 from etcd_tpu.server.distserver import pack_requests  # noqa: E402
 from etcd_tpu.wire.requests import Request  # noqa: E402
 
@@ -60,6 +65,50 @@ def weighted_pct(pairs, q):
         if cum >= q * total:
             return sec
     return pairs[-1][0]
+
+
+def fetch_ack_rtt(urls, timeout=5):
+    """Pool the hosts' server-side ack-RTT histograms (GET
+    /mraft/obs, merged by bucket) into cross-cluster p50/p99.
+
+    This is the consensus-RTT number proper: distserver stamps each
+    proposal at SEND (leader append + frame build) and closes the
+    clock at quorum-ack -> apply, so client-side queueing — which
+    polluted the r4/r5 ack p50 (Little's law at deep windows) —
+    cannot enter it."""
+    samples = []
+    for u in urls:
+        try:
+            with urllib.request.urlopen(u + "/mraft/obs",
+                                        timeout=timeout) as r:
+                snap = json.loads(r.read())
+            samples += snap.get("etcd_ack_rtt_seconds",
+                                {}).get("samples", [])
+        except Exception:
+            pass
+    merged = merge_histograms(samples)
+    if merged is None:
+        return None
+    out = {
+        "ack_rtt_consensus_p50_ms": round(percentile_from_buckets(
+            merged["bounds"], merged["buckets"], 0.5) * 1e3, 1),
+        "ack_rtt_consensus_p99_ms": round(percentile_from_buckets(
+            merged["bounds"], merged["buckets"], 0.99) * 1e3, 1),
+        "ack_rtt_samples": merged["count"],
+        # bucket-boundary estimates (upper bounds): the merge spans
+        # processes, so exact ring percentiles don't pool
+        "ack_rtt_estimator": "bucket-le-upper-bound",
+    }
+    # a quantile landing in the +Inf overflow bucket is clamped to
+    # the last finite bound — flag it so the row can never read as a
+    # clean measurement (the roofline ceiling_suspect rule, applied
+    # to latency)
+    finite = sum(merged["buckets"][:-1])
+    for q, key in ((0.5, "ack_rtt_p50_floor"),
+                   (0.99, "ack_rtt_p99_floor")):
+        if q * merged["count"] > finite:
+            out[key] = True
+    return out
 
 
 def free_ports(n):
@@ -192,9 +241,13 @@ def main() -> None:
             t.join()
         dt = time.perf_counter() - t0
         done = sum(acked)
+        rtt = fetch_ack_rtt(urls) or {}
         print(json.dumps({
             "hosts": 3, "groups": G, "conns": conns,
             "window": window,
+            # max client-side writes in flight: conns windows deep
+            "in_flight": conns * window,
+            **rtt,
             # workload identity: r4 rows used 8 per-conn namespaces
             # (<=8 active groups); hashed-spread activates ~all G —
             # don't compare across schemes without noting this

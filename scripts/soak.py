@@ -124,6 +124,12 @@ def main() -> int:
 
     final = rss_mb()
     leak = (baseline_rss is not None and final > 2 * baseline_rss)
+    # /metrics-equivalent snapshot (PR 2): the full obs ledger —
+    # span histograms, wal fsync latency, apply batches, elections,
+    # devledger transfer counters — rides the soak artifact, so a
+    # long run carries its own observability record
+    from etcd_tpu.obs.metrics import registry as obs_registry
+
     summary = {
         "minutes": round((time.time() - t0) / 60, 1), "groups": g,
         "ops": ops, "errors": errors, "watch_fired": watch_fired,
@@ -132,6 +138,7 @@ def main() -> int:
         "rss_final_mb": round(final, 1),
         "rss_peak_mb": round(peak_rss_mb(), 1), "rss_doubled": leak,
         "clean": errors == 0 and not leak,
+        "metrics": obs_registry.snapshot(),
     }
     print(json.dumps(summary), flush=True)
     return 0 if summary["clean"] else 1
