@@ -22,6 +22,10 @@ that cost whole rounds and that the 6-minute suite cannot see:
 - **metrics-vocabulary** (metricsvocab.py): every obs-registry
   accessor call uses a string-literal metric name registered in
   obs/metrics.py's CATALOG — no ad-hoc metric keys (PR 2).
+- **device-boundary** (boundary.py): ``np.asarray``/``np.array`` on
+  a just-produced jitted result inside a per-round loop — the
+  transfer-per-round tax behind the 24x restart regression (PR 3;
+  the runtime half lives in obs/devledger.py).
 
 ``scripts/lint`` runs the registry over the tree and gates on
 ``analysis_baseline.json`` (accepted legacy findings, each with a
@@ -32,6 +36,7 @@ The engine is stdlib-``ast`` only — no third-party deps, safe to run
 anywhere the repo imports.
 """
 
+from .boundary import DeviceBoundaryChecker
 from .durability import DurabilityOrderingChecker
 from .engine import (
     Baseline,
@@ -51,11 +56,13 @@ ALL_CHECKERS = (
     DurabilityOrderingChecker(),
     ErrorVocabularyChecker(),
     MetricsVocabularyChecker(),
+    DeviceBoundaryChecker(),
 )
 
 __all__ = [
     "ALL_CHECKERS",
     "Baseline",
+    "DeviceBoundaryChecker",
     "DurabilityOrderingChecker",
     "ErrorVocabularyChecker",
     "Finding",

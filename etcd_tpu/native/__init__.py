@@ -104,6 +104,25 @@ def _load():
         lib.etcd_chain_verify.argtypes = [u8p, ctypes.c_uint64, u64p,
                                           u64p, u32p, ctypes.c_uint64,
                                           ctypes.c_uint32]
+        lib.etcd_chain_verify_mt.restype = ctypes.c_int64
+        lib.etcd_chain_verify_mt.argtypes = [u8p, ctypes.c_uint64,
+                                             u64p, u64p, u32p,
+                                             ctypes.c_uint64,
+                                             ctypes.c_uint32,
+                                             ctypes.c_uint64]
+        lib.etcd_wal_count_range.restype = ctypes.c_int64
+        lib.etcd_wal_count_range.argtypes = [u8p, ctypes.c_uint64,
+                                             ctypes.c_uint64,
+                                             ctypes.c_uint64, u64p]
+        lib.etcd_wal_scan_chunk.restype = ctypes.c_int64
+        lib.etcd_wal_scan_chunk.argtypes = [u8p, ctypes.c_uint64,
+                                            ctypes.c_uint64,
+                                            ctypes.c_uint64,
+                                            ctypes.c_uint32,
+                                            ctypes.c_int64, i64p, u32p,
+                                            u64p, u64p, u64p, u64p,
+                                            u64p, ctypes.c_uint64,
+                                            u64p, i64p]
         lib.etcd_wal_gen.restype = ctypes.c_int64
         lib.etcd_wal_gen.argtypes = [ctypes.c_uint64, ctypes.c_uint64,
                                      ctypes.c_uint64, ctypes.c_uint32,
@@ -208,22 +227,118 @@ def replay_verify(blob: np.ndarray, seed: int = 0):
 
 def chain_verify(blob: np.ndarray, data_off: np.ndarray,
                  data_len: np.ndarray, stored: np.ndarray,
-                 seed: int = 0) -> int:
+                 seed: int = 0, threads: int = 1) -> int:
     """CRC-only rolling-chain verification over pre-scanned record
-    spans (one native sweep; no re-parse).  Returns ``stored.size``
-    when the chain verifies, else the index of the first bad record;
-    raises on out-of-range spans."""
+    spans (one native sweep; no re-parse).  ``threads > 1`` shards the
+    sweep across record ranges (each link needs only its predecessor's
+    *stored* value, so ranges verify independently; the ctypes call
+    releases the GIL either way).  Returns ``stored.size`` when the
+    chain verifies, else the index of the first bad record; raises on
+    out-of-range spans."""
     lib = _load()
     if lib is None:
         raise NativeError("native library unavailable")
     u64 = ctypes.POINTER(ctypes.c_uint64)
-    return _check(lib.etcd_chain_verify(
+    args = (
         _u8(blob), blob.size,
         np.ascontiguousarray(data_off, np.uint64).ctypes.data_as(u64),
         np.ascontiguousarray(data_len, np.uint64).ctypes.data_as(u64),
         np.ascontiguousarray(stored, np.uint32).ctypes.data_as(
             ctypes.POINTER(ctypes.c_uint32)),
-        data_off.size, seed))
+        data_off.size, seed)
+    if threads > 1:
+        return _check(lib.etcd_chain_verify_mt(*args, threads))
+    return _check(lib.etcd_chain_verify(*args))
+
+
+def wal_count_range(blob: np.ndarray, pos: int = 0,
+                    budget: int | None = None) -> tuple[int, int]:
+    """Length-hop record count over one chunk: ``(count, next_pos)``
+    for the records a ``scan_chunk(pos, budget)`` call would emit."""
+    lib = _load()
+    if lib is None:
+        raise NativeError("native library unavailable")
+    if budget is None:
+        budget = blob.size
+    nxt = ctypes.c_uint64()
+    n = _check(lib.etcd_wal_count_range(_u8(blob), blob.size, pos,
+                                        budget, ctypes.byref(nxt)))
+    return n, nxt.value
+
+
+_SCAN_DTYPES = (np.int64, np.uint32, np.uint64, np.uint64, np.uint64,
+                np.uint64, np.uint64)
+
+
+def alloc_scan_arrays(n: int) -> tuple:
+    """Preallocated (types, crcs, data_off, data_len, ent_index,
+    ent_term, ent_type) arrays for ``n`` records — the whole-stream
+    buffers streaming callers hand to :func:`scan_chunk` via ``out``
+    so per-chunk sweeps write into slices instead of allocating."""
+    return tuple(np.empty(max(1, n), dt) for dt in _SCAN_DTYPES)
+
+
+def scan_chunk(blob: np.ndarray, pos: int = 0,
+               budget: int | None = None, seed: int = 0,
+               verify: bool = False, out: tuple | None = None,
+               out_base: int = 0):
+    """One fused chunk sweep: frame + parse (+ rolling-chain CRC check
+    when ``verify``) of the records starting at ``pos`` until at least
+    ``budget`` bytes are consumed (a straddling record belongs to this
+    chunk).  ``out``/``out_base`` write the records into preallocated
+    whole-stream arrays (:func:`alloc_scan_arrays`) starting at
+    ``out_base`` — no per-chunk allocation, no final concatenate.
+    Returns ``(types, crcs, data_off, data_len, ent_index, ent_term,
+    ent_type, next_pos)`` (views when ``out`` is given); a CRC
+    mismatch raises :class:`NativeError` with ``code == CRC_MISMATCH``
+    and ``bad_index`` = the chunk-local index of the first bad
+    record."""
+    lib = _load()
+    if lib is None:
+        raise NativeError("native library unavailable")
+    if budget is None:
+        budget = blob.size
+    if out is None:
+        cap, _ = wal_count_range(blob, pos, budget)
+        out = alloc_scan_arrays(cap)
+        out_base = 0
+        cap = max(1, cap)
+    else:
+        cap = out[0].size - out_base
+        if cap <= 0:
+            raise NativeError(_ERRORS[CAPACITY], CAPACITY)
+    types, crcs, doff, dlen, eidx, eterm, etype = (
+        a[out_base:] for a in out)
+    u64 = ctypes.POINTER(ctypes.c_uint64)
+    nxt = ctypes.c_uint64()
+    bad = ctypes.c_int64()
+    rc = lib.etcd_wal_scan_chunk(
+        _u8(blob), blob.size, pos, budget, seed, 1 if verify else 0,
+        types.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        crcs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        doff.ctypes.data_as(u64), dlen.ctypes.data_as(u64),
+        eidx.ctypes.data_as(u64), eterm.ctypes.data_as(u64),
+        etype.ctypes.data_as(u64), cap, ctypes.byref(nxt),
+        ctypes.byref(bad))
+    if rc == CRC_MISMATCH:
+        e = NativeError(_ERRORS[CRC_MISMATCH], CRC_MISMATCH)
+        e.bad_index = int(bad.value)
+        e.bad_stored = int(crcs[bad.value]) if bad.value >= 0 else 0
+        raise e
+    n = _check(rc)
+    return (types[:n], crcs[:n], doff[:n], dlen[:n], eidx[:n],
+            eterm[:n], etype[:n], nxt.value)
+
+
+def scan_verify(blob: np.ndarray, seed: int = 0):
+    """Whole-stream FUSED scan + rolling-chain verify: the Go
+    baseline's one-pass shape (wal/wal.go:164-216) with the scan
+    arrays as output — parse and CRC in a single sweep over the blob,
+    no ``etcd_chain_verify`` re-read.  Returns the same 7 arrays as
+    :func:`wal_scan`; raises on corruption (CRC mismatches carry
+    ``bad_index``/``bad_stored``)."""
+    out = scan_chunk(blob, 0, blob.size, seed=seed, verify=True)
+    return out[:7]
 
 
 def wal_gen(n_entries: int, payload_len: int, start_index: int = 1,

@@ -131,6 +131,27 @@ _DEFS = (
         "etcd_chaos_cycle_recovery_seconds", "histogram",
         "Chaos-drill kill -> all-groups-writable recovery per "
         "cycle.", buckets=RECOVERY_BUCKETS),
+    MetricDef(
+        "etcd_replay_backend_route", "gauge",
+        "Replay backend chosen by wal/backend_policy per decision "
+        "stage (replay | restart | e2e): 1 for the selected route "
+        "(host | device | stream), 0 for the others.",
+        labels=("stage", "route")),
+    MetricDef(
+        "etcd_replay_probe_bytes_per_sec", "gauge",
+        "Backend-policy startup probe throughput per pipeline leg "
+        "(host_scan | h2d | device_verify); 0 = leg unavailable or "
+        "probe failed.", labels=("leg",)),
+    MetricDef(
+        "etcd_replay_stream_chunk_bytes", "gauge",
+        "Chunk size the streaming replay pipeline is configured "
+        "with."),
+    MetricDef(
+        "etcd_replay_stream_chunk_seconds", "histogram",
+        "Per-chunk wall time of each streaming-replay stage "
+        "(scan | h2d | verify) — overlap shows as stage sums "
+        "exceeding the pipeline's wall clock.", labels=("stage",),
+        window=512),
 )
 
 #: name -> MetricDef; THE metric vocabulary (lint-enforced)

@@ -331,7 +331,7 @@ class DistServer:
         from .server import _replay_wal_raw
 
         self.wal, md, _hs, raw = _replay_wal_raw(
-            self._waldir, snap_index, self.backend)
+            self._waldir, snap_index, self.backend, stage="restart")
         info = Info.unmarshal(md or b"")
         if info.id != self.id:
             raise RuntimeError(

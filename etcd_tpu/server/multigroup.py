@@ -228,8 +228,11 @@ class MultiGroupServer:
         from .gereplay import scan as ge_stream_scan
         from .server import _replay_wal_raw
 
+        # restart replay routes through the measured backend policy
+        # (stage "restart" — the r05 24x tunnel-bound regression is
+        # the case the router exists to prevent)
         self.wal, md, hard_state, raw = _replay_wal_raw(
-            self._waldir, snap_index, self.backend)
+            self._waldir, snap_index, self.backend, stage="restart")
         info = Info.unmarshal(md or b"")
         if info.id != self.id:
             raise RuntimeError(

@@ -427,28 +427,51 @@ class EtcdServer:
 
 # In "auto" mode the batched device replay only pays off once the WAL
 # is big enough to amortize the jit compile (~seconds); below this the
-# sequential host path is faster.
-_DEVICE_REPLAY_MIN_BYTES = 8 << 20
+# host lane wins.  The threshold lives with the router (which also
+# gates its own device probe on it) so both stay in lockstep.
+from ..wal.backend_policy import (  # noqa: E402
+    DEVICE_MIN_BYTES as _DEVICE_REPLAY_MIN_BYTES,
+)
 
 
-def _replay_wal_raw(waldir: str, index: int, backend: str):
-    """WAL replay honoring --storage-backend; the device path keeps
-    entries as an un-materialized ``EntryBlock`` (struct-of-arrays —
-    the form array-based consumers like gereplay.scan feed on), the
-    host path yields an Entry list."""
+def _replay_wal_raw(waldir: str, index: int, backend: str,
+                    stage: str = "restart"):
+    """WAL replay honoring --storage-backend through the measured
+    backend router (wal/backend_policy): the router picks native-host
+    / device / streaming-device per its startup probe, ``stage``
+    names the decision in the obs registry and the policy snapshot
+    (bench rows attribute regressions to routing vs kernel).  The
+    fast lane keeps entries as an un-materialized ``EntryBlock``
+    (struct-of-arrays — the form array-based consumers like
+    gereplay.scan feed on); the repair-capable host path yields an
+    Entry list."""
     if backend != "host":
+        from .. import native
+        from ..wal.backend_policy import get_policy
+
         size = sum(
             os.path.getsize(os.path.join(waldir, f))
             for f in os.listdir(waldir))
-        if backend == "tpu" or size >= _DEVICE_REPLAY_MIN_BYTES:
+        pol = get_policy()
+        route = pol.route(stage, size_bytes=size,
+                          strict_device=(backend == "tpu"))
+        env_forced = pol.decisions[stage]["why"].startswith("env ")
+        # the host-routed fused native scan beats the pure-Python
+        # decoder at every size; the device lanes keep the old
+        # amortization threshold (jit compile is seconds) unless the
+        # operator's env override demands them
+        use_fast = (backend == "tpu" or env_forced
+                    or size >= _DEVICE_REPLAY_MIN_BYTES
+                    or (route == "host" and native.available()))
+        if use_fast:
             try:
                 from ..wal.replay_device import open_replay_device
 
                 with tracer.span("replay.device"):
                     w, md, hard_state, block = open_replay_device(
-                        waldir, index)
-                log.info("etcdserver: device replay of %d entries "
-                         "(%d bytes)", len(block), size)
+                        waldir, index, route=route)
+                log.info("etcdserver: %s-route replay of %d entries "
+                         "(%d bytes)", route, len(block), size)
                 return w, md, hard_state, block
             except Exception as e:
                 # a crash-torn tail must heal on EVERY backend — the
@@ -460,8 +483,13 @@ def _replay_wal_raw(waldir: str, index: int, backend: str):
                 if backend == "tpu" and not isinstance(
                         e, TornTailError):
                     raise
-                log.warning("etcdserver: device replay failed; "
-                            "falling back to host path", exc_info=True)
+                log.warning("etcdserver: %s-route replay failed; "
+                            "falling back to host path", route,
+                            exc_info=True)
+                # the decision artifact must name the lane that RAN
+                pol.note(stage, "host",
+                         f"{route} lane failed "
+                         f"({type(e).__name__}); host repair path")
     with tracer.span("replay.host"):
         w = WAL.open_at_index(waldir, index)
         # server restarts tolerate a crash-torn tail (unacked by

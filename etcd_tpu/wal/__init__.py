@@ -24,6 +24,7 @@ from .wal import (
     exist,
     parse_wal_name,
     search_index,
+    select_segments,
     is_valid_seq,
     wal_name,
 )
@@ -34,6 +35,7 @@ __all__ = [
     "wal_name",
     "parse_wal_name",
     "search_index",
+    "select_segments",
     "is_valid_seq",
     "METADATA_TYPE",
     "ENTRY_TYPE",

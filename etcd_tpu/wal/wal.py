@@ -103,6 +103,25 @@ def is_valid_seq(names: list[str]) -> bool:
     return True
 
 
+def select_segments(dirpath: str, index: int) -> list[str]:
+    """Sorted, seq-contiguous segment names whose chain covers
+    ``index`` — the shared restart seam behind ``open_at_index`` and
+    the device/streaming replay lanes (both must agree on which files
+    constitute the stream, or the two paths could replay different
+    bytes from the same directory)."""
+    try:
+        names = os.listdir(dirpath)
+    except OSError as e:
+        raise FileNotFoundError_(str(e)) from e
+    names = sorted(check_wal_names(names))
+    if not names:
+        raise FileNotFoundError_(dirpath)
+    i = search_index(names, index)
+    if i is None or not is_valid_seq(names[i:]):
+        raise FileNotFoundError_(f"no wal file covers index {index}")
+    return names[i:]
+
+
 def exist(dirpath: str) -> bool:
     try:
         return len(os.listdir(dirpath)) != 0
@@ -276,20 +295,9 @@ class WAL:
     def open_at_index(cls, dirpath: str, index: int) -> "WAL":
         """Open read-mode at ``index``; the caller must ``read_all``
         before appending (reference wal/wal.go:108-159)."""
-        try:
-            names = os.listdir(dirpath)
-        except OSError as e:
-            raise FileNotFoundError_(str(e)) from e
-        names = sorted(check_wal_names(names))
-        if not names:
-            raise FileNotFoundError_(dirpath)
-
-        name_index = search_index(names, index)
-        if name_index is None or not is_valid_seq(names[name_index:]):
-            raise FileNotFoundError_(f"no wal file covers index {index}")
-
+        names = select_segments(dirpath, index)
         files = [open(os.path.join(dirpath, n), "rb")
-                 for n in names[name_index:]]
+                 for n in names]
         seq, _ = parse_wal_name(names[-1])
         f = open(os.path.join(dirpath, names[-1]), "ab")
 

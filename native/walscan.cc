@@ -21,6 +21,8 @@
 
 #include <cstdint>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 namespace {
 
@@ -140,6 +142,7 @@ constexpr int64_t kErrCapacity = -3;
 constexpr int64_t kErrCRC = -4;
 
 constexpr int64_t kEntryType = 2;
+constexpr int64_t kCrcType = 4;
 
 // Parse one record body [pos, rend). Writes type/crc and data span
 // (absolute offsets); data_off/len are 0 if field 3 absent.
@@ -270,6 +273,46 @@ int64_t etcd_chain_verify(const uint8_t* buf, uint64_t n,
     if (stored[i] != chain) return static_cast<int64_t>(i);
   }
   return static_cast<int64_t>(count);
+}
+
+// Sharded rolling-chain CRC verification: the chain links depend only
+// on their *stored* predecessor, so record ranges verify independently
+// — thread t seeds from stored[lo-1] and sweeps [lo, hi).  Worth it
+// once the CRC work dwarfs thread startup (callers gate on count);
+// nthreads <= 1 falls back to the sequential sweep.  Returns `count`
+// when the whole chain verifies, the smallest bad-record index
+// otherwise, or kErrTruncated for an out-of-range span.
+int64_t etcd_chain_verify_mt(const uint8_t* buf, uint64_t n,
+                             const uint64_t* doff, const uint64_t* dlen,
+                             const uint32_t* stored, uint64_t count,
+                             uint32_t seed, uint64_t nthreads) {
+  if (nthreads <= 1 || count < 2 * nthreads)
+    return etcd_chain_verify(buf, n, doff, dlen, stored, count, seed);
+  if (nthreads > 64) nthreads = 64;
+  std::vector<int64_t> results(nthreads, static_cast<int64_t>(count));
+  std::vector<std::thread> workers;
+  uint64_t per = (count + nthreads - 1) / nthreads;
+  for (uint64_t t = 0; t < nthreads; t++) {
+    uint64_t lo = t * per;
+    uint64_t hi = lo + per < count ? lo + per : count;
+    if (lo >= hi) break;
+    workers.emplace_back([&, t, lo, hi] {
+      uint32_t chain = lo ? stored[lo - 1] : seed;
+      int64_t r = etcd_chain_verify(buf, n, doff + lo, dlen + lo,
+                                    stored + lo, hi - lo, chain);
+      if (r < 0)
+        results[t] = r;  // span error (negative code)
+      else if (static_cast<uint64_t>(r) < hi - lo)
+        results[t] = static_cast<int64_t>(lo) + r;  // first bad link
+    });
+  }
+  for (auto& w : workers) w.join();
+  int64_t best = static_cast<int64_t>(count);
+  for (int64_t r : results) {
+    if (r < 0) return r;
+    if (r < best) best = r;
+  }
+  return best;
 }
 
 // Batched GroupEntry parse for multi-group restart replay: given the
@@ -428,6 +471,91 @@ int64_t etcd_wal_scan(const uint8_t* buf, uint64_t n, int64_t* types,
     pos += rlen;
     count++;
   }
+  return count;
+}
+
+// Length-hop record count over [pos, pos+budget): counts the framed
+// records a scan-chunk call starting at `pos` would emit (a record
+// straddling the budget boundary counts toward this chunk), so
+// chunked callers allocate exactly.  Sets *next_pos to the first
+// byte after the chunk's last record.
+int64_t etcd_wal_count_range(const uint8_t* buf, uint64_t n, uint64_t pos,
+                             uint64_t budget, uint64_t* next_pos) {
+  uint64_t start = pos;
+  int64_t count = 0;
+  while (pos < n && pos - start < budget) {
+    if (pos + 8 > n) return kErrTruncated;
+    uint64_t rlen = read_len_le(buf + pos);
+    pos += 8;
+    if (len_negative(rlen)) return kErrProto;
+    if (rlen > n - pos) return kErrTruncated;
+    pos += rlen;
+    count++;
+  }
+  *next_pos = pos;
+  return count;
+}
+
+// The single-pass fused scan the reference's hot loop implies
+// (wal/wal.go:164-216): frame, proto-parse, entry extraction, and —
+// when `verify` is nonzero — the rolling-chain CRC check, all in ONE
+// sweep over [pos, min-record-boundary >= pos+budget).  This is both
+// the whole-stream fused replay (budget = n: parse + verify with no
+// second pass over the blob, closing etcd_chain_verify's re-read) and
+// the streaming pipeline's per-chunk scanner (budget = chunk size;
+// records never split across chunks — a straddling record belongs to
+// the chunk it starts in).
+//
+// `chain` seeds the rolling CRC; a leading crcType record at stream
+// offset 0 re-seeds it (the fresh-decoder rule, wal/wal.go:184-191 —
+// its own link then holds trivially).  On a mismatch, returns kErrCRC
+// with *first_bad = the CHUNK-LOCAL index of the bad record (output
+// arrays are valid up to and including it).  Otherwise returns the
+// record count and sets *next_pos to the next chunk's start.
+int64_t etcd_wal_scan_chunk(const uint8_t* buf, uint64_t n, uint64_t pos,
+                            uint64_t budget, uint32_t chain, int64_t verify,
+                            int64_t* types, uint32_t* crcs,
+                            uint64_t* data_off, uint64_t* data_len,
+                            uint64_t* ent_index, uint64_t* ent_term,
+                            uint64_t* ent_type, uint64_t cap,
+                            uint64_t* next_pos, int64_t* first_bad) {
+  uint64_t start = pos;
+  int64_t count = 0;
+  *first_bad = -1;
+  while (pos < n && pos - start < budget) {
+    if (pos + 8 > n) return kErrTruncated;
+    uint64_t rlen = read_len_le(buf + pos);
+    pos += 8;
+    if (len_negative(rlen)) return kErrProto;
+    if (rlen > n - pos) return kErrTruncated;
+    if (static_cast<uint64_t>(count) >= cap) return kErrCapacity;
+    int64_t rc = parse_record(buf, pos, pos + rlen, &types[count],
+                              &crcs[count], &data_off[count],
+                              &data_len[count]);
+    if (rc < 0) return rc;
+    ent_index[count] = 0;
+    ent_term[count] = 0;
+    ent_type[count] = 0;
+    if (types[count] == kEntryType && data_len[count]) {
+      rc = parse_entry(buf, data_off[count],
+                       data_off[count] + data_len[count],
+                       &ent_type[count], &ent_term[count],
+                       &ent_index[count]);
+      if (rc < 0) return rc;
+    }
+    if (verify) {
+      if (start == 0 && count == 0 && types[0] == kCrcType)
+        chain = crcs[0];  // fresh-decoder re-seed at the stream head
+      chain = go_update(chain, buf + data_off[count], data_len[count]);
+      if (crcs[count] != chain) {
+        *first_bad = count;
+        return kErrCRC;
+      }
+    }
+    pos += rlen;
+    count++;
+  }
+  *next_pos = pos;
   return count;
 }
 

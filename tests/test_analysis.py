@@ -21,6 +21,7 @@ import pytest
 
 from etcd_tpu.analysis import (
     ALL_CHECKERS,
+    DeviceBoundaryChecker,
     DurabilityOrderingChecker,
     ErrorVocabularyChecker,
     LockDisciplineChecker,
@@ -327,6 +328,80 @@ def test_durability_quiet_when_paths_sync(tmp_path):
     # buffered() itself is flagged (baseline-able); every synced or
     # raising path is clean, and the caller that syncs is clean
     assert scopes == {"W.buffered"}
+
+
+# -- 4b. device-boundary fires on seeded violations ---------------------------
+
+
+_BOUNDARY_BAD = """
+    import numpy as np
+    import jax
+
+    @jax.jit
+    def step(x):
+        return x + 1
+
+    def drive(x, n):
+        for _ in range(n):
+            x = step(x)
+            h = np.asarray(x)            # per-round fetch (name)
+            y = np.array(step(x))        # per-round fetch (direct)
+        return h, y
+"""
+
+_BOUNDARY_GOOD = """
+    import numpy as np
+    import jax
+
+    @jax.jit
+    def step(x):
+        return x + 1
+
+    def drive(x, n):
+        for _ in range(n):
+            x = step(x)                  # device-resident across
+        return np.asarray(x)             # rounds; ONE fetch at the end
+"""
+
+
+def test_boundary_fires_on_per_round_fetch(tmp_path):
+    root = _fixture_root(tmp_path, "etcd_tpu/server/loop.py",
+                         _BOUNDARY_BAD)
+    findings = run_checkers(root, [DeviceBoundaryChecker()])
+    assert len(findings) == 2
+    assert _rules(findings) == {"per-round-fetch"}
+    assert {f.detail for f in findings} == {"x", "step"}
+
+
+def test_boundary_quiet_on_hoisted_fetch(tmp_path):
+    root = _fixture_root(tmp_path, "etcd_tpu/server/loop.py",
+                         _BOUNDARY_GOOD)
+    assert run_checkers(root, [DeviceBoundaryChecker()]) == []
+
+
+def test_boundary_resolves_imported_jit_roots(tmp_path):
+    """The common split — kernels in ops/, the loop elsewhere — must
+    still be seen through the ``from X import y`` edge."""
+    _fixture_root(tmp_path, "etcd_tpu/ops/kern.py", """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def fused(x, k):
+            return x * k
+    """)
+    root = _fixture_root(tmp_path, "etcd_tpu/server/loop.py", """
+        import numpy as np
+        from ..ops.kern import fused
+
+        def drive(x, n):
+            while n:
+                n -= 1
+                out = np.asarray(fused(x, 2))   # cross-module fetch
+            return out
+    """)
+    findings = run_checkers(root, [DeviceBoundaryChecker()])
+    assert [f.detail for f in findings] == ["fused"]
 
 
 # -- 5. error-vocabulary fires on seeded violations ---------------------------
