@@ -26,6 +26,23 @@ that cost whole rounds and that the 6-minute suite cannot see:
   a just-produced jitted result inside a per-round loop — the
   transfer-per-round tax behind the 24x restart regression (PR 3;
   the runtime half lives in obs/devledger.py).
+- **static-shapes** (shapes.py): Python branching on a parameter's
+  ``.shape`` inside a jit root whose project call sites (via the
+  call graph) pass differently-shaped arrays — re-jit churn (PR 4).
+- **seq-contiguity** (seqcontig.py): ``self.seq += 1`` allocation
+  and the WAL-record construction that consumes it must stay
+  adjacent — no yield/await/lock gap where another allocator can
+  interleave (the out-of-order-seq restart class, PR 4).
+- **timeout-bands** (timeouts.py): ``election >= m`` and
+  ``heartbeat < election`` at every config surface — constructor
+  call sites AND argparse flag defaults (PR 4).
+
+Since PR 4 the suite is **whole-program**: ``callgraph.py`` builds a
+project import/call graph once per run (cached on the engine's
+``AnalysisContext`` beside the shared AST cache), the tracer-purity
+taint walk follows tainted arguments across module boundaries, and
+``scripts/lint --changed`` uses the reverse import closure to keep
+restricted runs sound.
 
 ``scripts/lint`` runs the registry over the tree and gates on
 ``analysis_baseline.json`` (accepted legacy findings, each with a
@@ -37,17 +54,24 @@ anywhere the repo imports.
 """
 
 from .boundary import DeviceBoundaryChecker
+from .callgraph import CallGraph
 from .durability import DurabilityOrderingChecker
 from .engine import (
+    AnalysisContext,
     Baseline,
     Finding,
     load_baseline,
+    prune_baseline,
     run_checkers,
+    target_files,
 )
 from .errorvocab import ErrorVocabularyChecker
 from .locks import LockDisciplineChecker
 from .metricsvocab import MetricsVocabularyChecker
 from .purity import TracerPurityChecker
+from .seqcontig import SeqContiguityChecker
+from .shapes import StaticShapeChecker
+from .timeouts import TimeoutBandChecker
 
 #: the registry scripts/lint and tests/test_analysis.py run
 ALL_CHECKERS = (
@@ -57,18 +81,28 @@ ALL_CHECKERS = (
     ErrorVocabularyChecker(),
     MetricsVocabularyChecker(),
     DeviceBoundaryChecker(),
+    StaticShapeChecker(),
+    SeqContiguityChecker(),
+    TimeoutBandChecker(),
 )
 
 __all__ = [
     "ALL_CHECKERS",
+    "AnalysisContext",
     "Baseline",
+    "CallGraph",
     "DeviceBoundaryChecker",
     "DurabilityOrderingChecker",
     "ErrorVocabularyChecker",
     "Finding",
     "LockDisciplineChecker",
     "MetricsVocabularyChecker",
+    "SeqContiguityChecker",
+    "StaticShapeChecker",
+    "TimeoutBandChecker",
     "TracerPurityChecker",
     "load_baseline",
+    "prune_baseline",
     "run_checkers",
+    "target_files",
 ]

@@ -120,7 +120,7 @@ class DeviceBoundaryChecker(Checker):
 
     # -- the check --------------------------------------------------------
 
-    def check(self, relpath, tree, source, root=None):
+    def check(self, relpath, tree, source, root=None, ctx=None):
         jit_roots = _jit_roots_of(tree) \
             | self._imported_jit_roots(tree, relpath, root)
         if not jit_roots:

@@ -199,7 +199,7 @@ class DurabilityOrderingChecker(Checker):
         "etcd_tpu/snap/snapshotter.py",
     )
 
-    def check(self, relpath, tree, source, root=None):
+    def check(self, relpath, tree, source, root=None, ctx=None):
         fns = list(iter_functions(tree))
         # fixpoint: which functions can exit dirty (by bare name —
         # good enough within one module)

@@ -21,6 +21,7 @@ import ast
 import hashlib
 import json
 import os
+import time
 from dataclasses import dataclass, field
 
 
@@ -46,10 +47,53 @@ class Finding:
                 f"  (fingerprint {self.fingerprint})")
 
 
+class AnalysisContext:
+    """Shared per-run state: ONE parsed AST per file (checkers and
+    the call graph read the same cache), plus the lazily-built
+    whole-program :class:`~.callgraph.CallGraph`.  It dies with the
+    run, so stale-root leaks between fixture trees are
+    impossible."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self._cache: dict[str, tuple[ast.AST, str]] = {}
+        self._lines: dict[str, list[str]] = {}
+        self._cg = None
+
+    def parse(self, relpath: str) -> tuple[ast.AST, str]:
+        hit = self._cache.get(relpath)
+        if hit is None:
+            with open(os.path.join(self.root, relpath)) as fh:
+                source = fh.read()
+            hit = (ast.parse(source, filename=relpath), source)
+            self._cache[relpath] = hit
+        return hit
+
+    def lines(self, relpath: str) -> list[str]:
+        hit = self._lines.get(relpath)
+        if hit is None:
+            try:
+                hit = self.parse(relpath)[1].splitlines()
+            except (OSError, SyntaxError):
+                hit = []
+            self._lines[relpath] = hit
+        return hit
+
+    @property
+    def callgraph(self):
+        if self._cg is None:
+            from .callgraph import CallGraph
+
+            self._cg = CallGraph(self.root, self.parse)
+        return self._cg
+
+
 class Checker:
     """One registered analysis.  Subclasses set ``name`` and
     ``targets`` (repo-relative paths or ``dir/`` prefixes) and
-    implement ``check``."""
+    implement ``check``.  ``ctx`` is the run's
+    :class:`AnalysisContext`; cross-module checkers query
+    ``ctx.callgraph``."""
 
     name = "base"
     targets: tuple[str, ...] = ()
@@ -62,8 +106,8 @@ class Checker:
         return False
 
     def check(self, relpath: str, tree: ast.AST, source: str,
-              root: str | None = None
-              ) -> list[Finding]:  # pragma: no cover
+              root: str | None = None, ctx: AnalysisContext | None
+              = None) -> list[Finding]:  # pragma: no cover
         raise NotImplementedError
 
 
@@ -118,29 +162,42 @@ def save_baseline(path: str, findings: list[Finding],
     return Baseline(entries=entries)
 
 
+def prune_baseline(path: str, findings: list[Finding],
+                   prior: Baseline) -> list[str]:
+    """Drop baseline entries whose fingerprints no longer fire
+    (keeping live entries' justifications verbatim) and rewrite the
+    file.  Returns the pruned fingerprints, sorted."""
+    live = {f.fingerprint for f in findings}
+    stale = sorted(set(prior.entries) - live)
+    if not stale:
+        return []
+    entries = {fp: e for fp, e in prior.entries.items()
+               if fp in live}
+    doc = {"version": 1, "entries": dict(sorted(entries.items()))}
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    prior.entries = entries
+    return stale
+
+
 def _suppressed(source_lines: list[str], f: Finding) -> bool:
     if not (1 <= f.line <= len(source_lines)):
         return False
     return f"lint: ok({f.checker})" in source_lines[f.line - 1]
 
 
-def run_checkers(root: str, checkers,
-                 paths: list[str] | None = None) -> list[Finding]:
-    """Run every checker over its target files under ``root``.
-    ``paths`` restricts the run (repo-relative; ``./``-prefixes are
-    normalized, and a path that selects no target file raises — a
-    silent zero-findings pass on a typo'd path would read as
-    clean).  Returns findings sorted by (path, line), inline
-    suppressions already dropped."""
-    if paths is not None:
-        paths = [os.path.normpath(p).replace(os.sep, "/")
-                 for p in paths]
+def target_files(root: str, checkers) -> dict[str, list]:
+    """relpath -> [checkers wanting it], expanded from each
+    checker's ``targets`` (``dir/`` prefixes walked)."""
     wanted: dict[str, list] = {}
     for c in checkers:
         for t in c.targets:
             if t.endswith("/"):
                 base = os.path.join(root, t)
-                for dirpath, _dirs, files in os.walk(base):
+                for dirpath, dirs, files in os.walk(base):
+                    dirs[:] = [d for d in dirs
+                               if d != "__pycache__"]
                     for fn in files:
                         if not fn.endswith(".py"):
                             continue
@@ -151,6 +208,45 @@ def run_checkers(root: str, checkers,
             else:
                 if os.path.exists(os.path.join(root, t)):
                     wanted.setdefault(t, []).append(c)
+    return wanted
+
+
+def _record_run_metrics(checkers, findings: list[Finding],
+                        seconds: float) -> None:
+    """Publish the run summary through the obs registry (CATALOG
+    families ``etcd_lint_findings{checker}`` /
+    ``etcd_lint_run_seconds``) — best-effort; analysis must keep
+    working even if the obs package is mid-refactor."""
+    try:
+        from ..obs.metrics import registry
+    except Exception:  # pragma: no cover - bootstrap order
+        return
+    per: dict[str, int] = {}
+    for f in findings:
+        per[f.checker] = per.get(f.checker, 0) + 1
+    for c in checkers:
+        registry.gauge("etcd_lint_findings", checker=c.name).set(
+            per.get(c.name, 0))
+    registry.gauge("etcd_lint_run_seconds").set(seconds)
+
+
+def run_checkers(root: str, checkers,
+                 paths: list[str] | None = None,
+                 ctx: AnalysisContext | None = None
+                 ) -> list[Finding]:
+    """Run every checker over its target files under ``root``.
+    ``paths`` restricts the run (repo-relative; ``./``-prefixes are
+    normalized, and a path that selects no target file raises — a
+    silent zero-findings pass on a typo'd path would read as
+    clean).  Returns findings sorted by (path, line), inline
+    suppressions already dropped; the run summary lands in the obs
+    registry (``etcd_lint_findings``/``etcd_lint_run_seconds``)."""
+    t0 = time.monotonic()
+    if paths is not None:
+        paths = [os.path.normpath(p).replace(os.sep, "/")
+                 for p in paths]
+    ctx = ctx if ctx is not None else AnalysisContext(root)
+    wanted = target_files(root, checkers)
 
     if paths is not None:
         unknown = [p for p in paths if p not in wanted]
@@ -161,18 +257,25 @@ def run_checkers(root: str, checkers,
                 f"etcd_tpu/wal/wal.py)")
 
     findings: list[Finding] = []
+    seen: set[tuple[str, int]] = set()
     for rel in sorted(wanted):
         if paths is not None and rel not in paths:
             continue
-        with open(os.path.join(root, rel)) as fh:
-            source = fh.read()
-        tree = ast.parse(source, filename=rel)
-        lines = source.splitlines()
+        tree, source = ctx.parse(rel)
         for c in wanted[rel]:
-            for f in c.check(rel, tree, source, root=root):
-                if not _suppressed(lines, f):
+            for f in c.check(rel, tree, source, root=root, ctx=ctx):
+                # cross-module checkers may flag a file other than
+                # the one being checked — suppression comments are
+                # honored at the FLAGGED site, and a finding reached
+                # via two different entry files counts once
+                lines = ctx.lines(f.path)
+                key = (f.fingerprint, f.line)
+                if key not in seen and not _suppressed(lines, f):
+                    seen.add(key)
                     findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    _record_run_metrics(checkers, findings,
+                        time.monotonic() - t0)
     return findings
 
 
@@ -189,6 +292,35 @@ def dotted_name(node: ast.AST) -> str:
         parts.append(node.id)
         return ".".join(reversed(parts))
     return ""
+
+
+def scope_map(tree: ast.AST) -> dict[ast.AST, str]:
+    """node -> enclosing ``Class.function`` scope ("" = module) for
+    every node in the module (deepest function wins)."""
+    owner: dict[ast.AST, str] = {}
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                scope = f"{prefix}.{child.name}" if prefix \
+                    else child.name
+                # plain assignment: inner functions are walked after
+                # their enclosing one, so the DEEPEST scope wins —
+                # scope feeds the finding fingerprint, so this must
+                # match the pre-consolidation per-checker behavior
+                for n in ast.walk(child):
+                    owner[n] = scope
+                walk(child, scope)
+            elif isinstance(child, ast.ClassDef):
+                name = f"{prefix}.{child.name}" if prefix \
+                    else child.name
+                walk(child, name)
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return owner
 
 
 def iter_functions(tree: ast.AST):

@@ -79,7 +79,7 @@ class ErrorVocabularyChecker(Checker):
     def __init__(self):
         self._vocab_cache: dict[str, tuple[set[str], set[int]]] = {}
 
-    def check(self, relpath, tree, source, root=None):
+    def check(self, relpath, tree, source, root=None, ctx=None):
         root = root or os.getcwd()
         if root not in self._vocab_cache:
             self._vocab_cache[root] = _load_vocab(root)

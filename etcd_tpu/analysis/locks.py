@@ -264,7 +264,7 @@ class LockDisciplineChecker(Checker):
     def __init__(self):
         self._cache: dict[str, dict[str, list[Finding]]] = {}
 
-    def check(self, relpath, tree, source, root=None):
+    def check(self, relpath, tree, source, root=None, ctx=None):
         root = root or os.getcwd()
         if root not in self._cache:
             self._cache[root] = self._analyze(root)

@@ -20,34 +20,10 @@ from __future__ import annotations
 
 import ast
 
-from .engine import Checker, Finding, dotted_name
+from .engine import Checker, Finding, dotted_name, scope_map
 
 _ACCESSORS = {"counter", "gauge", "histogram"}
 _RECEIVERS = {"registry", "obs_registry", "reg", "_reg", "_obs"}
-
-
-def _scope_map(tree: ast.AST) -> dict[ast.AST, str]:
-    """node -> enclosing ``Class.function`` scope (deepest wins)."""
-    owner: dict[ast.AST, str] = {}
-
-    def walk(node, prefix):
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef,
-                                  ast.AsyncFunctionDef)):
-                scope = f"{prefix}.{child.name}" if prefix \
-                    else child.name
-                for n in ast.walk(child):
-                    owner[n] = scope
-                walk(child, scope)
-            elif isinstance(child, ast.ClassDef):
-                name = f"{prefix}.{child.name}" if prefix \
-                    else child.name
-                walk(child, name)
-            else:
-                walk(child, prefix)
-
-    walk(tree, "")
-    return owner
 
 
 class MetricsVocabularyChecker(Checker):
@@ -63,13 +39,13 @@ class MetricsVocabularyChecker(Checker):
             return None
 
     def check(self, relpath: str, tree: ast.AST, source: str,
-              root: str | None = None) -> list[Finding]:
+              root: str | None = None, ctx=None) -> list[Finding]:
         if relpath == "etcd_tpu/obs/metrics.py":
             return []  # the catalog itself
         catalog = self._catalog()
         if catalog is None:  # pragma: no cover
             return []
-        owner = _scope_map(tree)
+        owner = scope_map(tree)
         out: list[Finding] = []
         for node in ast.walk(tree):
             if not isinstance(node, ast.Call):

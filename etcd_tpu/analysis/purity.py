@@ -9,6 +9,15 @@ propagate; ``.shape``/``.dtype``/``.ndim``/``.size`` and ``len()``
 de-taint (static at trace time).  Intra-module callees invoked with a
 tainted argument are visited too (their matching params tainted).
 
+The taint walk is **whole-program** (PR 4): a callee invoked with a
+tainted argument is followed even when it lives in another module —
+``from X import y`` names, module-alias calls and ``__init__.py``
+re-exports resolve through the project call graph
+(:mod:`.callgraph`), and findings land in the file that owns the
+hazard.  ``TracerPurityChecker(cross_module=False)`` restores the old
+per-module walk (the fixture tests use it to prove what the
+single-module view misses).
+
 Hazards (each a finding):
 
 - ``host-sync``: ``x.item()`` / ``np.<anything>(x)`` /
@@ -103,7 +112,7 @@ class _TaintVisitor(ast.NodeVisitor):
     def __init__(self, checker: "TracerPurityChecker", relpath: str,
                  scope: str, node: ast.AST, tainted: set[str],
                  index: _FunctionIndex, findings: list[Finding],
-                 visited: set):
+                 visited: set, ctx=None):
         self.c = checker
         self.relpath = relpath
         self.scope = scope
@@ -111,6 +120,7 @@ class _TaintVisitor(ast.NodeVisitor):
         self.index = index
         self.findings = findings
         self.visited = visited
+        self.ctx = ctx
         self._body(node)
 
     # -- taint rules -----------------------------------------------------
@@ -274,21 +284,39 @@ class _TaintVisitor(ast.NodeVisitor):
                        f"op inside jitted code",
                        fname)
 
-        # follow intra-module callees invoked with tainted args
-        if isinstance(node.func, ast.Name) \
-                and node.func.id in self.index.by_name:
-            tainted_args = [self.is_tainted(a) for a in node.args]
-            if any(tainted_args):
+        # follow callees invoked with tainted args: intra-module
+        # first, then across module boundaries via the call graph
+        tainted_args = [self.is_tainted(a) for a in node.args]
+        tainted_kws = any(self.is_tainted(k.value)
+                          for k in node.keywords)
+        if any(tainted_args) or tainted_kws:
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id in self.index.by_name:
                 for scope, fn in self.index.by_name[node.func.id]:
                     self.c._visit_function(
                         self.relpath, scope, fn,
                         self._callee_taint(fn, node, tainted_args),
                         self.index, self.findings, self.visited,
-                        leaf)
+                        leaf, ctx=self.ctx)
+            elif self.c.cross_module and self.ctx is not None:
+                cg = self.ctx.callgraph
+                for rel2, scope2, fn2 in cg.resolve_call(
+                        self.relpath, fname):
+                    if isinstance(fn2, ast.Lambda):
+                        continue
+                    mi2 = cg.module(rel2)
+                    if mi2 is None:
+                        continue
+                    # ModuleInfo exposes the same by_name map a
+                    # _FunctionIndex would — no second index cache
+                    self.c._visit_function(
+                        rel2, scope2, fn2,
+                        self._callee_taint(fn2, node, tainted_args),
+                        mi2, self.findings, self.visited, leaf,
+                        ctx=self.ctx)
         self.generic_visit(node)
 
-    @staticmethod
-    def _callee_taint(fn, call: ast.Call,
+    def _callee_taint(self, fn, call: ast.Call,
                       tainted_args: list[bool]) -> set[str]:
         params = [a.arg for a in fn.args.args]
         out = set()
@@ -296,8 +324,9 @@ class _TaintVisitor(ast.NodeVisitor):
             if t and i < len(params):
                 out.add(params[i])
         for kw in call.keywords:
-            if kw.arg and kw.arg in params:
-                out.add(kw.arg)  # conservatively tainted
+            if kw.arg and kw.arg in params \
+                    and self.is_tainted(kw.value):
+                out.add(kw.arg)
         return out
 
     # nested defs: visited when called/passed, not on definition
@@ -321,7 +350,12 @@ class TracerPurityChecker(Checker):
         "etcd_tpu/parallel/mesh.py",
     )
 
-    def check(self, relpath, tree, source, root=None):
+    def __init__(self, cross_module: bool = True):
+        #: follow tainted calls across module boundaries via the
+        #: project call graph; False = the pre-PR-4 per-module walk
+        self.cross_module = cross_module
+
+    def check(self, relpath, tree, source, root=None, ctx=None):
         findings: list[Finding] = []
         index = _FunctionIndex(tree)
         visited: set[tuple[str, frozenset]] = set()
@@ -329,7 +363,8 @@ class TracerPurityChecker(Checker):
         for scope, node, statics in roots:
             tainted = self._param_taint(node, statics)
             self._visit_function(relpath, scope, node, tainted,
-                                 index, findings, visited, "root")
+                                 index, findings, visited, "root",
+                                 ctx=ctx)
         # de-dup identical findings found via multiple paths
         seen = set()
         out = []
@@ -387,10 +422,10 @@ class TracerPurityChecker(Checker):
         return out
 
     def _visit_function(self, relpath, scope, node, tainted, index,
-                        findings, visited, via) -> None:
+                        findings, visited, via, ctx=None) -> None:
         key = (id(node), frozenset(tainted))
         if key in visited or len(visited) > 4000:
             return
         visited.add(key)
         _TaintVisitor(self, relpath, scope, node, tainted, index,
-                      findings, visited)
+                      findings, visited, ctx=ctx)

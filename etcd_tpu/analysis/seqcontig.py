@@ -1,0 +1,158 @@
+"""seq-contiguity: seq allocation and WAL-record construction must
+stay adjacent.
+
+The dist tier's WAL is one interleaved stream: every record carries
+``index=self.seq`` and restart replay treats the sequence as dense —
+a later seq landing on disk before an earlier one reads as an index
+gap and fails recovery (the out-of-order-seq class the chaos drill
+caught in distserver).  The code discipline that makes the bug
+unrepresentable is *adjacency*: between ``self.seq += 1`` (the
+allocation) and the first read of ``self.seq`` (the record
+construction / WAL save that consumes it) nothing may run that can
+interleave another allocator:
+
+- ``yield`` / ``yield from`` / ``await`` — another coroutine or the
+  consumer of a generator can allocate while this frame is parked;
+- releasing a lock (``*.release()`` on a lock-ish receiver) — the
+  very window the drill's kill-9 interleavings hit;
+- *acquiring* a lock (a ``with <lock-ish>:`` entered, or
+  ``*.acquire()``) — the allocation evidently happened OUTSIDE that
+  lock, so another thread inside it can allocate in between.
+
+Rule ``seq-gap`` flags each hazard sitting between an allocation and
+its consuming read; rule ``seq-orphan`` flags an allocation that is
+never read afterwards in the same function (a seq burned with no
+record — a silent gap on disk).  Plain computation between the two
+points is fine; so is holding a lock around the whole span (the
+normal distserver shape, enforced separately by lock-discipline).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Checker, Finding, dotted_name, iter_functions
+
+#: attribute spellings treated as THE sequence counter
+_SEQ_ATTRS = {"seq"}
+
+
+def _is_lockish(node: ast.AST) -> bool:
+    """Heuristic: the receiver names a lock (``self.lock``,
+    ``wal_lock``, ``self._mu``...)."""
+    name = dotted_name(node)
+    leaf = name.split(".")[-1].lower()
+    return ("lock" in leaf or "mutex" in leaf or leaf == "mu"
+            or leaf == "_mu")
+
+
+def _pos(node: ast.AST) -> tuple[int, int]:
+    return (getattr(node, "lineno", 0),
+            getattr(node, "col_offset", 0))
+
+
+class SeqContiguityChecker(Checker):
+    name = "seq-contiguity"
+    targets = ("etcd_tpu/server/",)
+
+    def check(self, relpath, tree, source, root=None, ctx=None):
+        findings: list[Finding] = []
+        for scope, fn in iter_functions(tree):
+            self._check_function(relpath, scope, fn, findings)
+        return findings
+
+    @staticmethod
+    def _walk_own(fn):
+        """ast.walk minus nested function/lambda bodies (those are
+        separate scopes with their own adjacency story, and
+        iter_functions visits them on their own)."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _check_function(self, relpath, scope, fn, findings) -> None:
+        allocs: list[tuple[tuple[int, int], ast.AST]] = []
+        reads: list[tuple[int, int]] = []
+        hazards: list[tuple[tuple[int, int], str, ast.AST]] = []
+        for node in self._walk_own(fn):
+            if isinstance(node, ast.AugAssign):
+                t = node.target
+                if isinstance(t, ast.Attribute) \
+                        and t.attr in _SEQ_ATTRS \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    allocs.append((_pos(node), node))
+            elif isinstance(node, ast.Attribute) \
+                    and node.attr in _SEQ_ATTRS \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self" \
+                    and isinstance(node.ctx, ast.Load):
+                reads.append(_pos(node))
+            elif isinstance(node, (ast.Yield, ast.YieldFrom)):
+                hazards.append((_pos(node), "yield", node))
+            elif isinstance(node, ast.Await):
+                hazards.append((_pos(node), "await", node))
+            elif isinstance(node, ast.AsyncFor):
+                # iterating an async source suspends per item
+                hazards.append((_pos(node), "await", node))
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                lockish = any(_is_lockish(item.context_expr)
+                              for item in node.items)
+                if lockish:
+                    hazards.append(
+                        (_pos(node), "lock-acquire", node))
+                elif isinstance(node, ast.AsyncWith):
+                    # __aenter__ suspends even on a non-lock manager
+                    hazards.append((_pos(node), "await", node))
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("release", "acquire") \
+                    and _is_lockish(node.func.value):
+                hazards.append(
+                    (_pos(node), f"lock-{node.func.attr}", node))
+        if not allocs:
+            return
+        reads.sort()
+        hazards.sort(key=lambda h: h[0])
+        allocs.sort(key=lambda a: a[0])
+        alloc_positions = [a[0] for a in allocs]
+        for i, (pos, alloc) in enumerate(allocs):
+            # the protected span runs until the NEXT allocation (or
+            # the function end): every read in it consumes THIS seq
+            # value, so a hazard before the LAST such read is a gap —
+            # an incidental early read (logging) must not mask a
+            # hazard sitting before the real record construction
+            end = (alloc_positions[i + 1]
+                   if i + 1 < len(allocs) else (1 << 60, 0))
+            span_reads = [r for r in reads if pos < r < end]
+            if not span_reads:
+                findings.append(Finding(
+                    checker=self.name, path=relpath,
+                    line=alloc.lineno, rule="seq-orphan",
+                    scope=scope,
+                    message=("`self.seq += 1` allocates a sequence "
+                             "number that is never written to a WAL "
+                             "record in this function — a silent "
+                             "index gap on restart replay"),
+                    detail="seq-orphan"))
+                continue
+            last_read = span_reads[-1]
+            for hpos, kind, hnode in hazards:
+                if pos < hpos < last_read:
+                    findings.append(Finding(
+                        checker=self.name, path=relpath,
+                        line=hnode.lineno, rule="seq-gap",
+                        scope=scope,
+                        message=(
+                            f"`{kind}` between `self.seq += 1` "
+                            f"(line {alloc.lineno}) and the record "
+                            f"construction that consumes it — "
+                            f"another allocator can interleave and "
+                            f"a later seq lands on disk first "
+                            f"(out-of-order-seq restart gap)"),
+                        detail=kind))

@@ -1,0 +1,209 @@
+"""timeout-bands: election/heartbeat/member-count band invariants.
+
+DistMember's stratified election bands carve ``m`` disjoint
+width->=1 bands out of ``[election, 2*election)`` — impossible when
+``election < m``, which is why the constructor clamps ``election =
+max(election, m)`` (PR 1).  A clamp protects the process but hides
+the misconfiguration: the operator asked for a 4-tick election on an
+8-host cluster and silently got 8.  This checker lifts the invariant
+to every *config surface* so the bad number is caught where it is
+written down:
+
+- ``election-band``: a construction call (``DistMember`` /
+  ``MultiRaft`` / ``init_groups`` / ``DistServer``) whose member
+  count and election ticks are both statically known with
+  ``election < m``.  ``DistServer``'s ``m`` is ``len(peer_urls)``
+  when the list is a literal; omitted ``election`` uses the callee's
+  known default.
+- ``heartbeat-band``: classic-tier ``Raft`` / ``start_node`` /
+  ``restart_node`` calls with constant ``heartbeat >= election`` —
+  a leader that beats slower than followers time out can never hold
+  leadership (raft.go invariant).
+- ``cli-band``: in an argparse surface, an ``--*election*`` flag
+  whose literal default is smaller than a ``--*members*`` flag's
+  default in the same module, or a non-positive election default —
+  the CLI is a config surface too, and its defaults are the most
+  widely deployed config of all.
+
+Dynamic values stay quiet (the runtime clamp still covers them);
+this checker exists so constants written in code and flag tables
+obey the band *before* the clamp rewrites them.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Checker, Finding, dotted_name, scope_map
+
+
+def _const_int(node: ast.AST | None) -> int | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    return None
+
+
+def _arg(call: ast.Call, pos: int | None, kw: str):
+    for k in call.keywords:
+        if k.arg == kw:
+            return k.value
+    if pos is not None and pos < len(call.args):
+        return call.args[pos]
+    return None
+
+
+#: callee leaf name -> (m positional index, election positional
+#: index, election default).  Positions track the real signatures:
+#: DistMember(g, m, slot, cap, election=10),
+#: MultiRaft(g, m, cap, election=10),
+#: init_groups(g, m, cap, election=10).
+_ELECTION_CTORS = {
+    "DistMember": (1, 4, 10),
+    "MultiRaft": (1, 3, 10),
+    "init_groups": (1, 3, 10),
+}
+
+#: classic tier: (election positional index, heartbeat positional
+#: index) — Raft(id, peers, election, heartbeat),
+#: start_node(id, peers, election, heartbeat),
+#: restart_node(id, election, heartbeat, ...)
+_HEARTBEAT_CTORS = {
+    "Raft": (2, 3),
+    "start_node": (2, 3),
+    "restart_node": (1, 2),
+}
+
+
+class TimeoutBandChecker(Checker):
+    name = "timeout-bands"
+    targets = ("etcd_tpu/", "scripts/", "bench.py")
+
+    def check(self, relpath, tree, source, root=None, ctx=None):
+        findings: list[Finding] = []
+        scopes = scope_map(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = dotted_name(node.func).split(".")[-1]
+            if leaf in _ELECTION_CTORS:
+                self._check_election(relpath, scopes.get(node, ""),
+                                     leaf, node, findings)
+            elif leaf == "DistServer":
+                self._check_distserver(relpath,
+                                       scopes.get(node, ""), node,
+                                       findings)
+            elif leaf in _HEARTBEAT_CTORS:
+                self._check_heartbeat(relpath,
+                                      scopes.get(node, ""), leaf,
+                                      node, findings)
+        self._check_argparse(relpath, tree, scopes, findings)
+        return findings
+
+    def _check_election(self, relpath, scope, leaf, call,
+                        findings) -> None:
+        # DistMember is the engine seam: g is positional, m may be
+        # positional or keyword
+        m_pos, e_pos, e_default = _ELECTION_CTORS[leaf]
+        m = _const_int(_arg(call, m_pos, "m"))
+        e_node = _arg(call, e_pos, "election")
+        e = _const_int(e_node) if e_node is not None else e_default
+        if m is None or e is None:
+            return
+        if e < m:
+            findings.append(Finding(
+                checker=self.name, path=relpath, line=call.lineno,
+                rule="election-band", scope=scope,
+                message=(
+                    f"`{leaf}(... m={m}, election={e})`: "
+                    f"{m} disjoint election bands cannot fit in "
+                    f"[{e}, {2 * e}) — the runtime clamps election "
+                    f"up to {m}, so this config lies about its "
+                    f"recovery bound; pass election >= m"),
+                detail=f"{leaf}:m>{e}"))
+
+    def _check_distserver(self, relpath, scope, call,
+                          findings) -> None:
+        peers = _arg(call, None, "peer_urls")
+        if not isinstance(peers, (ast.List, ast.Tuple)):
+            return
+        m = len(peers.elts)
+        e_node = _arg(call, None, "election")
+        e = _const_int(e_node) if e_node is not None else 10
+        if e is None or m == 0:
+            return
+        if e < m:
+            findings.append(Finding(
+                checker=self.name, path=relpath, line=call.lineno,
+                rule="election-band", scope=scope,
+                message=(
+                    f"`DistServer(... peer_urls=<{m} hosts>, "
+                    f"election={e})`: {m} disjoint election bands "
+                    f"cannot fit in [{e}, {2 * e}) — pass "
+                    f"election >= len(peer_urls)"),
+                detail=f"DistServer:m>{e}"))
+
+    def _check_heartbeat(self, relpath, scope, leaf, call,
+                         findings) -> None:
+        e_pos, h_pos = _HEARTBEAT_CTORS[leaf]
+        e = _const_int(_arg(call, e_pos, "election"))
+        h = _const_int(_arg(call, h_pos, "heartbeat"))
+        if e is None or h is None:
+            return
+        if h >= e:
+            findings.append(Finding(
+                checker=self.name, path=relpath, line=call.lineno,
+                rule="heartbeat-band", scope=scope,
+                message=(
+                    f"`{leaf}(... election={e}, heartbeat={h})`: "
+                    f"the heartbeat interval must be strictly "
+                    f"below the election timeout or followers "
+                    f"campaign against a healthy leader"),
+                detail=f"{leaf}:hb>={h}"))
+
+    def _check_argparse(self, relpath, tree, scopes,
+                        findings) -> None:
+        election: list[tuple[str, int, ast.Call]] = []
+        members: list[tuple[str, int]] = []
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "add_argument"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            flag = node.args[0].value
+            default = _const_int(_arg(node, None, "default"))
+            if default is None:
+                continue
+            if "election" in flag:
+                election.append((flag, default, node))
+            elif "members" in flag:
+                members.append((flag, default))
+        for flag, default, node in election:
+            scope = scopes.get(node, "")
+            if default <= 0:
+                findings.append(Finding(
+                    checker=self.name, path=relpath,
+                    line=node.lineno, rule="cli-band", scope=scope,
+                    message=(f"`{flag}` default {default} is not a "
+                             f"positive tick count"),
+                    detail=f"{flag}:nonpos"))
+                continue
+            for mflag, mdefault in members:
+                if default < mdefault:
+                    findings.append(Finding(
+                        checker=self.name, path=relpath,
+                        line=node.lineno, rule="cli-band",
+                        scope=scope,
+                        message=(
+                            f"`{flag}` default {default} is below "
+                            f"`{mflag}` default {mdefault}: "
+                            f"{mdefault} member election bands "
+                            f"cannot fit in [{default}, "
+                            f"{2 * default}) — raise the election "
+                            f"default to at least the member "
+                            f"default"),
+                        detail=f"{flag}<{mflag}"))
+

@@ -113,6 +113,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "under the cross-host tier; --cohosted-groups "
                         "must divide by the mesh's group axis; 0 = "
                         "single device)")
+    # default 60 ticks (3s at the 0.05s tick): wide enough for every
+    # supported host count's stratified bands and the jit-compile
+    # first round; the timeout-bands lint checker guards this default
+    # against the members default, and start_dist re-checks it
+    # against the actual --dist-peers count (the DistMember clamp
+    # would silently stretch a too-small value)
+    p.add_argument("--dist-election-ticks", type=int, default=60,
+                   help="Election timeout in ticks for --dist-slot "
+                        "mode; must be >= the number of --dist-peers "
+                        "hosts so per-slot election bands stay "
+                        "disjoint")
     # v0.4.6 back-compat (main.go:87-98); values are validated as
     # strict IP:port (pkg/flags/ipaddressport.go semantics)
     p.add_argument("--addr", default=None, type=parse_ip_address_port,
@@ -208,6 +219,17 @@ def start_dist(args, explicit: set[str]) -> int:
         log.error("dist mode needs --dist-peers with >=2 slot-indexed "
                   "URLs and --dist-slot within range")
         return 1
+    if args.dist_election_ticks < len(peers):
+        # the distmember election>=m clamp made mechanical at the
+        # config surface: refuse rather than silently stretching the
+        # operator's number (timeout-bands invariant)
+        log.error("--dist-election-ticks=%d is below the host count "
+                  "%d: %d disjoint per-slot election bands cannot "
+                  "fit in [%d, %d) — pass at least %d",
+                  args.dist_election_ticks, len(peers), len(peers),
+                  args.dist_election_ticks,
+                  2 * args.dist_election_ticks, len(peers))
+        return 1
     data_dir = args.data_dir or f"{args.name}_dist{args.dist_slot}_data"
     os.makedirs(data_dir, mode=0o700, exist_ok=True)
     g = args.cohosted_groups or 64
@@ -230,6 +252,7 @@ def start_dist(args, explicit: set[str]) -> int:
         s = DistServer(data_dir, slot=args.dist_slot, peer_urls=peers,
                        g=g, name=f"{args.name}-{args.dist_slot}",
                        snap_count=args.snapshot_count,
+                       election=args.dist_election_ticks,
                        storage_backend=args.storage_backend,
                        client_urls=list(acurls), mesh=mesh,
                        peer_tls=peer_tls if not peer_tls.empty()

@@ -152,6 +152,15 @@ _DEFS = (
         "(scan | h2d | verify) — overlap shows as stage sums "
         "exceeding the pipeline's wall clock.", labels=("stage",),
         window=512),
+    MetricDef(
+        "etcd_lint_findings", "gauge",
+        "Findings per checker in the last static-analysis run "
+        "(baselined findings included; suppressed ones not).",
+        labels=("checker",)),
+    MetricDef(
+        "etcd_lint_run_seconds", "gauge",
+        "Wall seconds of the last static-analysis run "
+        "(scripts/lint or tests/test_analysis.py)."),
 )
 
 #: name -> MetricDef; THE metric vocabulary (lint-enforced)

@@ -39,6 +39,21 @@ from ..ops.quorum import maybe_commit_batch
 from ..raft.batched import GroupState, replication_round
 
 
+def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """``jax.shard_map`` across the jax version band: the public
+    ``jax.shard_map`` (with ``check_vma``) landed after 0.4.x, where
+    the same transform lives at ``jax.experimental.shard_map`` and
+    spells the replication check ``check_rep``."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs,
+                  out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as esm
+
+    return esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
 def group_mesh(n_devices: int | None = None) -> Mesh:
     """Build a 2D ``(g, s)`` mesh over the first ``n_devices`` devices.
 
@@ -186,7 +201,7 @@ def make_sharded_step(mesh: Mesh):
         return links_ok, state, err, ncomm, commit_all
 
     gspec = GroupState(*([P("g")] * len(GroupState._fields)))
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         step, mesh=mesh,
         in_specs=(P("g", "s"), P("g"), P("g"), P(), gspec, P("g"),
                   P("g"), P("g", None), P("g", None), P("g", None),
@@ -256,7 +271,7 @@ def make_replay_commit_step(mesh: Mesh):
             new_committed, "g", tiled=True)
         return links_ok, committed_all
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         step, mesh=mesh,
         in_specs=(P("g", "s"), P("g"), P("g"), P(), P("g"), P("g"),
                   P("g"), P("g"), P("g", None), P("g"), P("s", None)),

@@ -21,12 +21,17 @@ import pytest
 
 from etcd_tpu.analysis import (
     ALL_CHECKERS,
+    AnalysisContext,
     DeviceBoundaryChecker,
     DurabilityOrderingChecker,
     ErrorVocabularyChecker,
     LockDisciplineChecker,
+    SeqContiguityChecker,
+    StaticShapeChecker,
+    TimeoutBandChecker,
     TracerPurityChecker,
     load_baseline,
+    prune_baseline,
     run_checkers,
 )
 
@@ -148,6 +153,169 @@ def test_purity_follows_callee_with_tainted_args(tmp_path):
     findings = run_checkers(root, [TracerPurityChecker()])
     assert any(f.rule == "host-cast" and f.scope == "helper"
                for f in findings)
+
+
+# -- 2b. cross-module purity taint (PR 4 tentpole) ----------------------------
+
+
+_XMOD_HELPER = """
+    def helper(y):
+        return float(y)            # host-cast when y is traced
+"""
+
+_XMOD_ROOT = """
+    import jax
+    from etcd_tpu.wal.util import helper
+
+    @jax.jit
+    def root_fn(x):
+        return helper(x)
+"""
+
+
+def test_purity_taint_crosses_module_boundaries(tmp_path):
+    """The acceptance fixture: the per-module walk (cross_module=
+    False, the pre-PR-4 behavior) provably misses a hazard the
+    whole-program walk reports in the file that owns it."""
+    _fixture_root(tmp_path, "etcd_tpu/wal/util.py", _XMOD_HELPER)
+    root = _fixture_root(tmp_path, "etcd_tpu/ops/a.py", _XMOD_ROOT)
+    old = run_checkers(
+        root, [TracerPurityChecker(cross_module=False)])
+    assert old == [], "per-module walk should NOT see the hazard"
+    findings = run_checkers(root, [TracerPurityChecker()])
+    assert any(f.rule == "host-cast"
+               and f.path == "etcd_tpu/wal/util.py"
+               and f.scope == "helper" for f in findings), findings
+
+
+def test_purity_cross_module_follows_relative_and_alias(tmp_path):
+    _fixture_root(tmp_path, "etcd_tpu/wal/util.py", """
+        import numpy as np
+
+        def helper(y):
+            return np.asarray(y)   # host-sync when y is traced
+    """)
+    root = _fixture_root(tmp_path, "etcd_tpu/ops/a.py", """
+        import jax
+        from ..wal.util import helper as h
+
+        @jax.jit
+        def root_fn(x):
+            return h(x)
+    """)
+    findings = run_checkers(root, [TracerPurityChecker()])
+    assert any(f.rule == "host-sync"
+               and f.path == "etcd_tpu/wal/util.py"
+               for f in findings), findings
+
+
+def test_purity_cross_module_suppression_at_flagged_site(tmp_path):
+    """`# lint: ok(...)` is honored in the FILE THAT OWNS the
+    hazard, not the entry module."""
+    _fixture_root(tmp_path, "etcd_tpu/wal/util.py", """
+        def helper(y):
+            return float(y)  # lint: ok(tracer-purity)
+    """)
+    root = _fixture_root(tmp_path, "etcd_tpu/ops/a.py", _XMOD_ROOT)
+    assert run_checkers(root, [TracerPurityChecker()]) == []
+
+
+def test_purity_untainted_keyword_does_not_taint_callee(tmp_path):
+    """A constant keyword argument must not taint the callee's
+    parameter (the multiraft->batched `write_mode` false-positive
+    class)."""
+    _fixture_root(tmp_path, "etcd_tpu/wal/util.py", """
+        def helper(y, mode="dense"):
+            if mode == "scatter":  # mode is host data: fine
+                return y * 2
+            return y
+    """)
+    root = _fixture_root(tmp_path, "etcd_tpu/ops/a.py", """
+        import jax
+        from etcd_tpu.wal.util import helper
+
+        @jax.jit
+        def root_fn(x):
+            return helper(x, mode="scatter")
+    """)
+    assert run_checkers(root, [TracerPurityChecker()]) == []
+
+
+# -- 2c. the call graph itself ------------------------------------------------
+
+
+def _callgraph_fixture(tmp_path) -> AnalysisContext:
+    _fixture_root(tmp_path, "etcd_tpu/wal/util.py", """
+        def helper(y):
+            return y
+    """)
+    _fixture_root(tmp_path, "etcd_tpu/wal/__init__.py", """
+        from .util import helper
+    """)
+    root = _fixture_root(tmp_path, "etcd_tpu/ops/a.py", """
+        import etcd_tpu.wal.util
+        import etcd_tpu.wal.util as wu
+        from ..wal import helper as rel_reexp
+        from etcd_tpu.wal import helper as abs_reexp
+        from etcd_tpu.wal.util import helper as direct
+
+        def drive(x):
+            return (direct(x), rel_reexp(x), abs_reexp(x),
+                    wu.helper(x), etcd_tpu.wal.util.helper(x))
+    """)
+    return AnalysisContext(root)
+
+
+def test_callgraph_resolves_every_import_spelling(tmp_path):
+    ctx = _callgraph_fixture(tmp_path)
+    cg = ctx.callgraph
+    for spelling in ("direct", "rel_reexp", "abs_reexp",
+                     "wu.helper", "etcd_tpu.wal.util.helper"):
+        res = cg.resolve_call("etcd_tpu/ops/a.py", spelling)
+        assert [(r[0], r[1]) for r in res] == [
+            ("etcd_tpu/wal/util.py", "helper")], (spelling, res)
+
+
+def test_callgraph_call_sites_invert_resolution(tmp_path):
+    ctx = _callgraph_fixture(tmp_path)
+    sites = ctx.callgraph.call_sites_of(
+        "etcd_tpu/wal/util.py", "helper")
+    # all five spellings in drive() resolve back to the one def
+    assert len(sites) == 5
+    assert {(rel, scope) for rel, scope, _call in sites} == {
+        ("etcd_tpu/ops/a.py", "drive")}
+
+
+def test_callgraph_reverse_dependents_close_transitively(tmp_path):
+    _fixture_root(tmp_path, "etcd_tpu/wal/util.py", "X = 1\n")
+    _fixture_root(tmp_path, "etcd_tpu/wal/mid.py",
+                  "from .util import X\n")
+    root = _fixture_root(tmp_path, "etcd_tpu/ops/a.py",
+                         "from ..wal.mid import X\n")
+    ctx = AnalysisContext(root)
+    deps = ctx.callgraph.reverse_dependents(
+        {"etcd_tpu/wal/util.py"})
+    assert deps == {"etcd_tpu/wal/mid.py", "etcd_tpu/ops/a.py"}
+    # forward direction (a changed caller can create findings in
+    # the modules it imports — the --changed scope needs both)
+    fwd = ctx.callgraph.import_closure({"etcd_tpu/ops/a.py"})
+    assert fwd == {"etcd_tpu/wal/mid.py", "etcd_tpu/wal/util.py"}
+
+
+def test_scope_map_deepest_function_wins():
+    """Finding.scope feeds the fingerprint: nodes inside nested
+    functions must be owned by the DEEPEST enclosing scope, matching
+    the pre-consolidation per-checker maps."""
+    import ast as _ast
+
+    from etcd_tpu.analysis.engine import scope_map
+
+    tree = _ast.parse(
+        "def outer():\n    def inner():\n        x = 1\n")
+    sm = scope_map(tree)
+    assign = next(n for n in _ast.walk(tree)
+                  if isinstance(n, _ast.Assign))
+    assert sm[assign] == "outer.inner"
 
 
 # -- 3. lock-discipline fires on seeded violations ----------------------------
@@ -404,6 +572,294 @@ def test_boundary_resolves_imported_jit_roots(tmp_path):
     assert [f.detail for f in findings] == ["fused"]
 
 
+# -- 4c. static-shapes fires on seeded violations -----------------------------
+
+
+_SHAPES_KERNEL = """
+    import jax
+
+    @jax.jit
+    def kern(x):
+        if x.shape[0] > 4:          # shape-dependent Python branch
+            return x * 2
+        return x
+"""
+
+
+def test_shapes_fire_on_divergent_call_sites(tmp_path):
+    _fixture_root(tmp_path, "etcd_tpu/ops/kern.py", _SHAPES_KERNEL)
+    root = _fixture_root(tmp_path, "etcd_tpu/server/loop.py", """
+        import jax.numpy as jnp
+        from ..ops.kern import kern
+
+        def drive():
+            a = kern(jnp.zeros((4,)))    # two statically different
+            b = kern(jnp.zeros((8, 2)))  # shapes -> re-jit churn
+            return a, b
+    """)
+    findings = run_checkers(root, [StaticShapeChecker()])
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "shape-branch"
+    assert f.path == "etcd_tpu/ops/kern.py"
+    assert f.detail == "kern.x"
+
+
+def test_shapes_quiet_on_single_shape_and_unknown(tmp_path):
+    _fixture_root(tmp_path, "etcd_tpu/ops/kern.py", _SHAPES_KERNEL)
+    root = _fixture_root(tmp_path, "etcd_tpu/server/loop.py", """
+        import jax.numpy as jnp
+        from ..ops.kern import kern
+
+        def drive(runtime_arr):
+            a = kern(jnp.zeros((4,)))    # one proven shape
+            b = kern(jnp.zeros((4,)))    # ... repeated
+            c = kern(runtime_arr)        # unknown: not evidence
+            return a, b, c
+    """)
+    assert run_checkers(root, [StaticShapeChecker()]) == []
+
+
+def test_shapes_quiet_on_static_argnames_branch(tmp_path):
+    root = _fixture_root(tmp_path, "etcd_tpu/ops/kern.py", """
+        import functools
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("pad",))
+        def kern(x, pad):
+            if pad.shape and False:  # never: pad is declared static
+                return x
+            return x
+
+        def drive():
+            return kern(jnp.zeros((4,)), 1), kern(jnp.zeros((8,)), 2)
+    """)
+    assert run_checkers(root, [StaticShapeChecker()]) == []
+
+
+# -- 4d. seq-contiguity fires on seeded violations ----------------------------
+
+
+_SEQ_BAD = """
+    class S:
+        def alloc_then_yield(self):
+            self.seq += 1
+            yield "parked"                 # seq-gap: yield
+            self.wal.append(self.seq)
+
+        def alloc_outside_lock(self, rec):
+            self.seq += 1
+            with self.lock:                # seq-gap: lock-acquire
+                self.wal.append(rec, self.seq)
+
+        def orphan(self):
+            self.seq += 1                  # seq-orphan: never read
+"""
+
+_SEQ_GOOD = """
+    class S:
+        def persist(self, ents):
+            with self.lock:
+                self.seq += 1
+                ents.append(("rec", self.seq))
+                self.wal.save(self.seq, ents)
+
+        def batch(self, items):
+            with self.lock:
+                out = []
+                for p in items:
+                    self.seq += 1
+                    out.append(("rec", self.seq, p))
+                self.wal.save(self.seq, out)
+"""
+
+
+def test_seqcontig_fires_on_each_gap_class(tmp_path):
+    root = _fixture_root(tmp_path, "etcd_tpu/server/distserver.py",
+                         _SEQ_BAD)
+    findings = run_checkers(root, [SeqContiguityChecker()])
+    by_scope = {f.scope: f for f in findings}
+    assert by_scope["S.alloc_then_yield"].detail == "yield"
+    assert by_scope["S.alloc_outside_lock"].detail == "lock-acquire"
+    assert by_scope["S.orphan"].rule == "seq-orphan"
+    assert len(findings) == 3
+
+
+def test_seqcontig_quiet_on_adjacent_allocation(tmp_path):
+    root = _fixture_root(tmp_path, "etcd_tpu/server/distserver.py",
+                         _SEQ_GOOD)
+    assert run_checkers(root, [SeqContiguityChecker()]) == []
+
+
+def test_seqcontig_fires_on_async_with_and_masked_read(tmp_path):
+    root = _fixture_root(tmp_path, "etcd_tpu/server/distserver.py",
+                         """
+        class S:
+            async def async_gap(self, rec):
+                self.seq += 1
+                async with self.lock:        # suspends AND acquires
+                    self.wal.append(rec, self.seq)
+
+            def masked_read(self):
+                self.seq += 1
+                self.log(self.seq)           # incidental early read
+                with self.lock:              # still a gap before...
+                    self.wal.append(self.seq)  # ...the REAL consume
+    """)
+    findings = run_checkers(root, [SeqContiguityChecker()])
+    by_scope = {}
+    for f in findings:
+        by_scope.setdefault(f.scope, []).append(f)
+    assert [f.detail for f in by_scope["S.async_gap"]] \
+        == ["lock-acquire"]
+    assert [f.detail for f in by_scope["S.masked_read"]] \
+        == ["lock-acquire"]
+
+
+# -- 4e. timeout-bands fires on seeded violations -----------------------------
+
+
+def test_timeouts_fire_on_election_and_heartbeat_bands(tmp_path):
+    root = _fixture_root(tmp_path, "etcd_tpu/server/boot.py", """
+        from etcd_tpu.raft.core import Raft
+        from etcd_tpu.raft.distmember import DistMember
+
+        def build():
+            mm = DistMember(8, 12, 0, 16, election=4)  # 4 < m=12
+            rr = Raft(1, [2, 3], 5, 7)                 # hb 7 >= 5
+            return mm, rr
+    """)
+    findings = run_checkers(root, [TimeoutBandChecker()])
+    rules = _rules(findings)
+    assert {"election-band", "heartbeat-band"} == rules
+    assert any(f.detail == "DistMember:m>4" for f in findings)
+
+
+def test_timeouts_fire_on_distserver_literal_peer_list(tmp_path):
+    root = _fixture_root(tmp_path, "etcd_tpu/server/boot.py", """
+        from etcd_tpu.server.distserver import DistServer
+
+        def build(d):
+            return DistServer(
+                d, slot=0,
+                peer_urls=["u0", "u1", "u2", "u3", "u4"],
+                election=3)                # 3 < len(peer_urls)=5
+    """)
+    findings = run_checkers(root, [TimeoutBandChecker()])
+    assert [f.rule for f in findings] == ["election-band"]
+
+
+def test_timeouts_fire_on_argparse_defaults(tmp_path):
+    root = _fixture_root(tmp_path, "etcd_tpu/server/boot.py", """
+        import argparse
+
+        def build_parser():
+            p = argparse.ArgumentParser()
+            p.add_argument("--dist-election-ticks", type=int,
+                           default=2)
+            p.add_argument("--cohosted-members", type=int,
+                           default=5)
+            return p
+    """)
+    findings = run_checkers(root, [TimeoutBandChecker()])
+    assert [f.rule for f in findings] == ["cli-band"]
+    assert "--dist-election-ticks" in findings[0].message
+
+
+def test_timeouts_tables_match_real_signatures():
+    """The checker's positional tables are copies of the real
+    constructor signatures; this pins them so a signature change
+    (param inserted before `election`, default bumped) fails HERE
+    instead of silently muting every call-site check."""
+    import ast as _ast
+
+    from etcd_tpu.analysis.timeouts import (
+        _ELECTION_CTORS,
+        _HEARTBEAT_CTORS,
+    )
+
+    def params_defaults(relpath, name, method="__init__"):
+        tree = _ast.parse(
+            open(os.path.join(REPO, relpath)).read())
+        for node in _ast.walk(tree):
+            if isinstance(node, _ast.ClassDef) and node.name == name:
+                node = next(n for n in node.body
+                            if isinstance(n, _ast.FunctionDef)
+                            and n.name == method)
+            elif not (isinstance(node, _ast.FunctionDef)
+                      and node.name == name):
+                continue
+            args = node.args
+            names = [a.arg for a in args.args if a.arg != "self"]
+            defaults = dict(zip(names[len(names)
+                                      - len(args.defaults):],
+                                args.defaults))
+            kwdefs = {a.arg: d for a, d in
+                      zip(args.kwonlyargs, args.kw_defaults)
+                      if d is not None}
+            return names, {**defaults, **kwdefs}
+        raise AssertionError(f"{name} not found in {relpath}")
+
+    sigs = {
+        "DistMember": params_defaults(
+            "etcd_tpu/raft/distmember.py", "DistMember"),
+        "MultiRaft": params_defaults(
+            "etcd_tpu/raft/multiraft.py", "MultiRaft"),
+        "init_groups": params_defaults(
+            "etcd_tpu/raft/batched.py", "init_groups"),
+    }
+    for leaf, (m_pos, e_pos, e_default) in _ELECTION_CTORS.items():
+        names, defaults = sigs[leaf]
+        assert names[m_pos] == "m", (leaf, names)
+        assert names[e_pos] == "election", (leaf, names)
+        d = defaults["election"]
+        assert isinstance(d, _ast.Constant) and d.value == e_default
+
+    hb_sigs = {
+        "Raft": params_defaults("etcd_tpu/raft/core.py", "Raft"),
+        "start_node": params_defaults(
+            "etcd_tpu/raft/node.py", "start_node"),
+        "restart_node": params_defaults(
+            "etcd_tpu/raft/node.py", "restart_node"),
+    }
+    for leaf, (e_pos, h_pos) in _HEARTBEAT_CTORS.items():
+        names, _d = hb_sigs[leaf]
+        assert names[e_pos] == "election", (leaf, names)
+        assert names[h_pos] == "heartbeat", (leaf, names)
+
+    # DistServer: election is keyword-only with the default the
+    # checker assumes (10), peer_urls keyword-only too
+    names, defaults = params_defaults(
+        "etcd_tpu/server/distserver.py", "DistServer")
+    d = defaults["election"]
+    assert isinstance(d, _ast.Constant) and d.value == 10
+
+
+def test_timeouts_quiet_on_banded_configs(tmp_path):
+    root = _fixture_root(tmp_path, "etcd_tpu/server/boot.py", """
+        import argparse
+
+        from etcd_tpu.raft.core import Raft
+        from etcd_tpu.raft.distmember import DistMember
+
+        def build(m_dyn):
+            a = DistMember(8, 12, 0, 16, election=16)
+            b = DistMember(8, m_dyn, 0, 16, election=4)  # dynamic m
+            c = Raft(1, [2, 3], 10, 1)
+            return a, b, c
+
+        def build_parser():
+            p = argparse.ArgumentParser()
+            p.add_argument("--dist-election-ticks", type=int,
+                           default=60)
+            p.add_argument("--cohosted-members", type=int,
+                           default=3)
+            return p
+    """)
+    assert run_checkers(root, [TimeoutBandChecker()]) == []
+
+
 # -- 5. error-vocabulary fires on seeded violations ---------------------------
 
 
@@ -518,6 +974,78 @@ def test_scripts_lint_exits_zero_on_real_tree():
         [sys.executable, os.path.join(REPO, "scripts", "lint")],
         capture_output=True, text=True, cwd=REPO)
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_lint_run_summary_lands_on_metrics(tmp_path):
+    """The PR-4 obs satellite: a lint run publishes per-checker
+    finding counts and wall time through the registry, visible in
+    the GET /metrics exposition."""
+    from etcd_tpu.obs.exporter import render_prometheus
+
+    root = _fixture_root(tmp_path, "etcd_tpu/wal/wal.py", """
+        class W:
+            def bad_a(self, data):
+                self.f.write(data)
+                return 1
+
+            def bad_b(self, data):
+                self.f.write(data)
+                return 2
+    """)
+    run_checkers(root, [DurabilityOrderingChecker()])
+    text = render_prometheus().decode()
+    assert ('etcd_lint_findings{checker="durability-ordering"} 2'
+            in text), text
+    line = next(ln for ln in text.splitlines()
+                if ln.startswith("etcd_lint_run_seconds"))
+    assert float(line.split()[-1]) > 0.0
+
+
+def test_prune_baseline_drops_only_dead_entries(tmp_path):
+    import json
+
+    root = _fixture_root(tmp_path, "etcd_tpu/wal/wal.py", """
+        class W:
+            def bad(self, data):
+                self.f.write(data)
+                return 1
+    """)
+    findings = run_checkers(root, [DurabilityOrderingChecker()])
+    (live,) = findings
+    bl_path = str(tmp_path / "analysis_baseline.json")
+    with open(bl_path, "w") as fh:
+        json.dump({"version": 1, "entries": {
+            live.fingerprint: {"checker": live.checker,
+                               "path": live.path,
+                               "justification": "still real"},
+            "deadbeefdeadbeef": {"checker": "durability-ordering",
+                                 "path": "gone.py",
+                                 "justification": "fixed long ago"},
+        }}, fh)
+    prior = load_baseline(bl_path)
+    removed = prune_baseline(bl_path, findings, prior)
+    assert removed == ["deadbeefdeadbeef"]
+    after = load_baseline(bl_path)
+    assert set(after.entries) == {live.fingerprint}
+    assert after.entries[live.fingerprint]["justification"] \
+        == "still real"
+    # idempotent: nothing left to prune
+    assert prune_baseline(bl_path, findings, after) == []
+
+
+def test_scripts_lint_changed_smoke():
+    """`--changed` restricts to git-diff files + their call-graph
+    closure and exits like the full gate (0 on a clean-or-baselined
+    tree)."""
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "lint"),
+         "--changed"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "lint --changed:" in r.stdout
 
 
 if __name__ == "__main__":
