@@ -124,6 +124,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "mode; must be >= the number of --dist-peers "
                         "hosts so per-slot election bands stay "
                         "disjoint")
+    p.add_argument("--dist-pipeline-depth", type=int, default=8,
+                   help="Max in-flight append frames per peer "
+                        "(windowed replication pipeline; 1 = "
+                        "lockstep-equivalent, one frame per peer at "
+                        "a time; >4 adds a second striped "
+                        "connection per peer)")
+    p.add_argument("--dist-coalesce-us", type=int, default=2000,
+                   help="Adaptive drain cadence: a batch flushes "
+                        "when full (entries/bytes) or this many "
+                        "microseconds after its first proposal, "
+                        "whichever first")
     # v0.4.6 back-compat (main.go:87-98); values are validated as
     # strict IP:port (pkg/flags/ipaddressport.go semantics)
     p.add_argument("--addr", default=None, type=parse_ip_address_port,
@@ -256,7 +267,9 @@ def start_dist(args, explicit: set[str]) -> int:
                        storage_backend=args.storage_backend,
                        client_urls=list(acurls), mesh=mesh,
                        peer_tls=peer_tls if not peer_tls.empty()
-                       else None)
+                       else None,
+                       pipeline_depth=args.dist_pipeline_depth,
+                       coalesce_us=args.dist_coalesce_us)
     except ValueError as e:
         log.error("%s", e)
         return 1

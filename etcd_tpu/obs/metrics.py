@@ -109,6 +109,26 @@ _DEFS = (
         "etcd_pending_proposals", "gauge",
         "Requeued proposals awaiting a leader or window space."),
     MetricDef(
+        "etcd_dist_pipeline_inflight", "gauge",
+        "Append frames currently in flight to each peer (windowed "
+        "pipeline, PR 5; bounded by --dist-pipeline-depth).",
+        labels=("peer",)),
+    MetricDef(
+        "etcd_dist_coalesce_entries", "histogram",
+        "Client proposals coalesced per drain flush (adaptive "
+        "cadence: max-entries/max-bytes threshold or the "
+        "--dist-coalesce-us timer, whichever first).",
+        buckets=SIZE_BUCKETS),
+    MetricDef(
+        "etcd_dist_frame_resend_total", "counter",
+        "Pipeline frames re-sent or acks dropped, by reason: "
+        "reconnect (transport died with frames in flight), reject "
+        "(follower gap -> probe catch-up), stale_seq (duplicate or "
+        "already-failed ack), stale_epoch (ack from a previous "
+        "leadership reign), closed (channel shutdown), expired "
+        "(in-flight past the ack deadline — backstop sweep).",
+        labels=("reason",)),
+    MetricDef(
         "etcd_devledger_dispatches_total", "counter",
         "Device dispatches crossing a jitted seam, per stage.",
         labels=("stage",)),

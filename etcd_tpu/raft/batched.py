@@ -252,8 +252,9 @@ def _maybe_append_jit(state, prev_idx, prev_term, ent_terms, n_ents,
                           commit=commit), ok, err_conflict, err_overflow
 
 
-@jax.jit
-def leader_append(state: GroupState, n_new, self_slot, active=None):
+@partial(jax.jit, static_argnames=("self_ack",))
+def leader_append(state: GroupState, n_new, self_slot, active=None,
+                  self_ack: bool = True):
     """Leader-side ``append_entry`` (raft.go:279-286): append n_new
     entries of the leader's term, update own progress.
 
@@ -261,6 +262,15 @@ def leader_append(state: GroupState, n_new, self_slot, active=None):
     Overflow lanes are left untouched (no partial window write, no
     ``last`` advance): the group stalls until compaction frees space
     while the rest of the batch proceeds.
+
+    ``self_ack=False`` (the pipelined dist tier) appends WITHOUT
+    advancing the leader's own ``match`` — the entries exist in the
+    engine log but do not yet count toward quorum.  The caller runs
+    :func:`progress_update` for its own slot (DistMember.ack_self)
+    once its WAL fsync covering them lands,
+    so a quorum can only ever be formed from DURABLE copies (Raft's
+    overlap rule: send may precede local durability, counting may
+    not).
     """
     g, cap = state.log_term.shape
     if active is None:
@@ -280,8 +290,10 @@ def leader_append(state: GroupState, n_new, self_slot, active=None):
 
     m = state.match.shape[1]
     onehot = jax.nn.one_hot(self_slot, m, dtype=bool)
-    match = jnp.where(do[:, None] & onehot, lastnew[:, None],
-                      state.match)
+    match = state.match
+    if self_ack:
+        match = jnp.where(do[:, None] & onehot, lastnew[:, None],
+                          match)
     next_ = jnp.where(do[:, None] & onehot, lastnew[:, None] + 1,
                       state.next_)
     last = jnp.where(do, lastnew, state.last)
@@ -303,6 +315,41 @@ def progress_update(state: GroupState, from_slot, idx, active=None):
     next_ = jnp.where(onehot, jnp.maximum(state.next_, idx[:, None] + 1),
                       state.next_)
     return state._replace(match=match, next_=next_)
+
+
+@jax.jit
+def progress_optimistic(state: GroupState, from_slot, idx,
+                        active=None):
+    """Pipelined leader: advance ``next_[from]`` past a just-SENT
+    window (etcd raft ``Progress.OptimisticUpdate``) so the next
+    frame carries the following entries without waiting for the ack.
+    ``match`` is untouched — only real acks may move quorum input."""
+    g, m = state.match.shape
+    if active is None:
+        active = jnp.ones((g,), bool)
+    active = active & (state.role == LEADER)
+    onehot = jax.nn.one_hot(from_slot, m, dtype=bool) & active[:, None]
+    next_ = jnp.where(onehot,
+                      jnp.maximum(state.next_, idx[:, None] + 1),
+                      state.next_)
+    return state._replace(next_=next_)
+
+
+@jax.jit
+def progress_probe(state: GroupState, from_slot, active=None):
+    """Pipelined leader on TRANSPORT failure to a peer: optimistic
+    ``next_`` advances for frames the peer never received must be
+    rolled back to the last confirmed point, ``match + 1`` (etcd raft
+    ``Progress.becomeProbe``).  Safe unconditionally: match only ever
+    reflects real acks, so resending from there is at worst a
+    duplicate prefix the follower's append check ignores."""
+    g, m = state.match.shape
+    if active is None:
+        active = jnp.ones((g,), bool)
+    active = active & (state.role == LEADER)
+    onehot = jax.nn.one_hot(from_slot, m, dtype=bool) & active[:, None]
+    return state._replace(next_=jnp.where(
+        onehot, jnp.maximum(state.match + 1, 1), state.next_))
 
 
 def progress_repair(state: GroupState, from_slot, hint,

@@ -38,13 +38,16 @@ from .batched import (
     LEADER,
     CANDIDATE,
     GroupState,
+    _append_write_mode,
+    _maybe_append_jit,
     apply_conf_change as conf_change_batch,
     compact as compact_batch,
     grant_vote,
     init_groups,
     leader_append,
-    maybe_append,
     maybe_commit,
+    progress_optimistic,
+    progress_probe,
     progress_repair,
     progress_update,
     restore_snapshot,
@@ -91,6 +94,77 @@ def _absorb_resp(state: GroupState, peer, term, ok, acked, hint,
                             active=active & ok)
     state = progress_repair(state, peer_v, hint, active=active & ~ok)
     return maybe_commit(state)
+
+
+@partial(jax.jit, static_argnames=("write_mode",))
+def _handle_append_fused(state: GroupState, sender_v, term, prev_idx,
+                         prev_term, ent_terms, n_ents, commit, active,
+                         need_snap, write_mode):
+    """The WHOLE follower-side msgApp step as ONE device dispatch:
+    higher-term adoption, leadership + election-timer reset,
+    maybe_append, and the response arrays packed into a single [G, 7]
+    i32 block (ok | cur | conflict | overflow | acked | term |
+    commit) so the host does one fetch instead of seven.
+
+    The unfused chain (PR 2's shape) cost ~8 eager dispatches per
+    frame — at the pipeline's frame rates that fixed per-frame tax
+    was the follower's single largest CPU line (measured via the
+    dist_bench span table)."""
+    st = _adopt_term(state, term, sender_v, active)
+    cur = active & (term == st.term)
+    st = st._replace(
+        role=jnp.where(cur, FOLLOWER, st.role),
+        lead=jnp.where(cur, sender_v, st.lead),
+        elapsed=jnp.where(cur, 0, st.elapsed))
+    do = cur & ~need_snap
+    st, ok, e_conf, e_over = _maybe_append_jit(
+        st, prev_idx, prev_term, ent_terms, n_ents, commit,
+        do, write_mode=write_mode)
+    need = need_snap & cur
+    commit_i = st.commit.astype(jnp.int32)
+    acked = jnp.where(need, commit_i,
+                      prev_idx + n_ents).astype(jnp.int32)
+    packed = jnp.stack([
+        ok.astype(jnp.int32), cur.astype(jnp.int32),
+        e_conf.astype(jnp.int32), e_over.astype(jnp.int32),
+        acked, st.term, commit_i], axis=1)
+    return st, packed
+
+
+@jax.jit
+def _ack_self_fused(state: GroupState, self_slot, upto):
+    """Durable self-ack + quorum commit in one dispatch."""
+    return maybe_commit(progress_update(state, self_slot, upto))
+
+
+@partial(jax.jit, static_argnames=("peer", "e"))
+def _build_append_fused(state: GroupState, lane_mask, peer, e):
+    """The msgApp window computation as ONE dispatch returning one
+    packed [G, 6 + e + 1] i32 block: active | need_snap | prev_idx |
+    n_ents | term | commit | terms2[e+1] — the host slices columns
+    out of a single fetch (the unfused form did five separate
+    device->host reads plus a term_at dispatch per peer per pump)."""
+    lead = state.role == LEADER
+    member = state.members[:, peer]
+    active = lead & member & lane_mask
+    nxt = state.next_[:, peer]
+    offset = state.offset
+    need_snap = active & (nxt <= offset) & (offset > 0)
+    sendable = active & ~need_snap
+    prev_idx = jnp.where(sendable, nxt - 1, 0).astype(jnp.int32)
+    n_ents = jnp.where(
+        sendable, jnp.clip(state.last - prev_idx, 0, e),
+        0).astype(jnp.int32)
+    idx = prev_idx[:, None] + 1 + jnp.arange(e, dtype=jnp.int32)
+    terms2 = term_at(state.log_term, state.offset, state.last,
+                     jnp.concatenate([prev_idx[:, None], idx],
+                                     axis=1))
+    return jnp.concatenate([
+        jnp.stack([active.astype(jnp.int32),
+                   need_snap.astype(jnp.int32),
+                   prev_idx, n_ents, state.term, state.commit],
+                  axis=1),
+        terms2], axis=1)
 
 
 @partial(jax.jit, static_argnames=("slot",))
@@ -234,16 +308,23 @@ class DistMember:
     # -- leader path ------------------------------------------------------
 
     def propose(self, n_new: np.ndarray,
-                data: list[list[bytes]] | None = None):
+                data: list[list[bytes]] | None = None,
+                self_ack: bool = True):
         """Append ``n_new[g]`` entries on lanes where this slot leads.
         Returns (valid, base): which lanes accepted, and each lane's
-        pre-append last index (keys the caller's bookkeeping)."""
+        pre-append last index (keys the caller's bookkeeping).
+
+        ``self_ack=False`` (the pipelined server): the append does NOT
+        advance this slot's own match — the caller counts its own ack
+        via :meth:`ack_self` only after the WAL fsync covering these
+        entries has landed, so commit can never form a quorum out of
+        a non-durable local copy."""
         st = self.state
         base = np.asarray(st.last)
         lead = self.is_leader()
         st, err = leader_append(
             st, self._put(n_new, np.int32),
-            self._full(self.slot))
+            self._full(self.slot), self_ack=self_ack)
         self.state = st
         overflow = np.asarray(err)
         self.errors["overflow"] = overflow
@@ -254,31 +335,28 @@ class DistMember:
                     self.payloads[gi][int(base[gi]) + 1 + j] = blob
         return valid, base
 
-    def build_append(self, peer: int) -> AppendBatch | None:
+    def build_append(self, peer: int,
+                     lane_mask: np.ndarray | None = None
+                     ) -> AppendBatch | None:
         """The batched msgApp frame for ``peer``: every lane this slot
         leads sends its window [next_[peer], min(next+E-1, last)] (or
-        a need_snap flag past compaction, raft.go:207-209)."""
-        st = self.state
-        lead = self.is_leader()
-        member = np.asarray(st.members)[:, peer]
-        active = lead & member
+        a need_snap flag past compaction, raft.go:207-209).
+
+        ``lane_mask`` restricts the frame to a subset of groups — the
+        pipelined server stripes groups across parallel connections,
+        and each stripe's frames must cover only ITS lanes so one
+        lane's appends always ride one ordered connection."""
+        mask = (np.ones(self.g, bool) if lane_mask is None
+                else np.asarray(lane_mask, bool))
+        p = np.asarray(_build_append_fused(
+            self.state, self._put(mask), peer=peer, e=self.e))
+        active = p[:, 0].astype(bool)
         if not active.any():
             return None
-        nxt = np.asarray(st.next_)[:, peer]
-        offset = np.asarray(st.offset)
-        last = np.asarray(st.last)
-        need_snap = active & (nxt <= offset) & (offset > 0)
-        sendable = active & ~need_snap
-        prev_idx = np.where(sendable, nxt - 1, 0).astype(np.int32)
-        n_ents = np.where(
-            sendable, np.clip(last - prev_idx, 0, self.e),
-            0).astype(np.int32)
-        idx = prev_idx[:, None] + 1 + np.arange(self.e, dtype=np.int32)
-        # one device gather for prev terms + entry terms
-        terms2 = np.asarray(term_at(
-            st.log_term, st.offset, st.last,
-            self._put(np.concatenate(
-                [prev_idx[:, None], idx], axis=1))))
+        need_snap = p[:, 1].astype(bool)
+        prev_idx = p[:, 2]
+        n_ents = p[:, 3]
+        terms2 = p[:, 6:]
         payloads = []
         for gi in range(self.g):
             row = []
@@ -287,11 +365,38 @@ class DistMember:
                     int(prev_idx[gi]) + 1 + j, b""))
             payloads.append(row)
         return AppendBatch(
-            sender=self.slot, term=np.asarray(st.term),
+            sender=self.slot, term=p[:, 4],
             prev_idx=prev_idx, prev_term=terms2[:, 0],
-            n_ents=n_ents, commit=np.asarray(st.commit),
+            n_ents=n_ents, commit=p[:, 5],
             active=active, need_snap=need_snap,
             ent_terms=terms2[:, 1:], payloads=payloads)
+
+    def ack_self(self, upto: np.ndarray) -> None:
+        """Count this host's own DURABLE ack (pipelined mode):
+        advance own match to ``upto`` (monotone max) once the WAL
+        fsync covering entries ``<= upto`` has landed, then
+        quorum-commit — one fused dispatch."""
+        self.state = _ack_self_fused(self.state,
+                                     self._full(self.slot),
+                                     self._put(upto, np.int32))
+
+    def optimistic_advance(self, peer: int, b: AppendBatch) -> None:
+        """Advance ``next_[:, peer]`` past the window just SENT in
+        frame ``b`` (etcd raft OptimisticUpdate) so the next
+        build_append ships the following entries without waiting for
+        the ack.  match is untouched — only real acks move quorum."""
+        sent = (np.asarray(b.prev_idx)
+                + np.asarray(b.n_ents)).astype(np.int32)
+        active = np.asarray(b.active) & ~np.asarray(b.need_snap)
+        self.state = progress_optimistic(
+            self.state, self._full(peer),
+            self._put(sent, np.int32), active=self._put(active))
+
+    def probe_reset(self, peer: int) -> None:
+        """Roll ``next_[:, peer]`` back to ``match + 1`` after a
+        transport failure dropped in-flight frames (etcd raft
+        becomeProbe): resend from the last CONFIRMED point."""
+        self.state = progress_probe(self.state, self._full(peer))
 
     def handle_append_resp(self, r: AppendResp) -> np.ndarray:
         """Absorb a peer's batched response; returns the [G] commit
@@ -308,28 +413,25 @@ class DistMember:
     def handle_append(self, b: AppendBatch) -> AppendResp:
         """Batched msgApp receipt (stepFollower, raft.go:496-504):
         adopt higher terms, maybe_append current-term lanes, store
-        payloads, reply with match/hint arrays.  The CALLER persists
-        the accepted entries BEFORE shipping the response."""
-        st = self.state
-        active = self._put(b.active)
-        term = self._put(b.term)
-        st = _adopt_term(st, term, self._full(b.sender), active)
-        # equal-term appends also establish leadership + reset timer
-        cur = active & (term == st.term)
-        st = st._replace(
-            role=jnp.where(cur, FOLLOWER, st.role),
-            lead=jnp.where(cur, b.sender, st.lead),
-            elapsed=jnp.where(cur, 0, st.elapsed))
-        do = cur & ~self._put(b.need_snap)
-        st, ok, e_conf, e_over = maybe_append(
-            st, self._put(b.prev_idx), self._put(b.prev_term),
+        payloads, reply with match/hint arrays — ONE fused device
+        dispatch + ONE packed fetch per frame (the pipeline's frame
+        rates made the unfused chain's ~8 dispatches the follower's
+        top CPU line).  The CALLER persists the accepted entries
+        BEFORE shipping the response."""
+        st, packed = _handle_append_fused(
+            self.state, self._full(b.sender), self._put(b.term),
+            self._put(b.prev_idx), self._put(b.prev_term),
             self._put(b.ent_terms), self._put(b.n_ents),
-            self._put(b.commit), active=do)
+            self._put(b.commit), self._put(b.active),
+            self._put(b.need_snap),
+            write_mode=_append_write_mode())
         self.state = st
-        self.errors["conflict"] = np.asarray(e_conf)
+        p = np.asarray(packed)
+        ok_np = p[:, 0].astype(bool)
+        cur = p[:, 1].astype(bool)
+        self.errors["conflict"] = p[:, 2].astype(bool)
         self.errors["overflow"] = (self.errors["overflow"]
-                                   | np.asarray(e_over))
-        ok_np = np.asarray(ok)
+                                   | p[:, 3].astype(bool))
         for gi in np.nonzero(ok_np)[0]:
             for j in range(int(b.n_ents[gi])):
                 self.payloads[gi][int(b.prev_idx[gi]) + 1 + j] = \
@@ -343,17 +445,16 @@ class DistMember:
         # next_, but a need_snap lane sends no append to reject, so
         # without this positive ack the leader re-flags need_snap
         # forever and the follower loops snapshot pulls — found by
-        # the chaos drill.)
-        need = np.asarray(b.need_snap) & np.asarray(cur)
-        commit_np = np.asarray(st.commit, dtype=np.int32)
+        # the chaos drill.)  The fused op already folded the need
+        # lanes into acked (= commit there); ok/active fold here.
+        need_mask = np.asarray(b.need_snap)
+        need = need_mask & cur
         return AppendResp(
-            sender=self.slot, term=np.asarray(st.term),
+            sender=self.slot, term=p[:, 5],
             ok=ok_np | need,
-            acked=np.where(need, commit_np,
-                           b.prev_idx + b.n_ents).astype(np.int32),
-            hint=commit_np,
-            active=np.asarray(cur) | (np.asarray(b.need_snap)
-                                      & np.asarray(active)),
+            acked=p[:, 4],
+            hint=p[:, 6],
+            active=cur | (need_mask & np.asarray(b.active)),
             appended=ok_np)
 
     def install_snapshot(self, frontier: np.ndarray,

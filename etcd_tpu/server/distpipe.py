@@ -1,0 +1,185 @@
+"""Windowed append-pipeline bookkeeping for the dist tier (PR 5
+tentpole).
+
+The lockstep leader round (one frame per peer, one HTTP round trip,
+absorb, repeat) serialized four latencies per committed batch:
+leader fsync -> send -> follower fsync -> response.  Raft permits a
+leader to keep MANY uncommitted append frames in flight per follower
+and to overlap its own fsync with the sends (the standard
+pipelining/batching port, arXiv:1905.10786 §4); this module is the
+per-peer state machine that makes that safe over a drop-tolerant
+transport:
+
+- every append frame carries ``(epoch, seq)``: seq numbers frames
+  per peer, epoch is bumped whenever the local leadership set
+  changes, so late acks from a previous reign can NEVER touch
+  progress state (``stale_epoch``);
+- acks may return out of order (striped connections) and are matched
+  to the exact in-flight frame; unknown/duplicate seqs are counted
+  and dropped (``stale_seq``) — match_index only ever advances off a
+  matched ack, and monotonically (the engine's progress_update is a
+  max);
+- per peer the pipe is either REPLICATE (window of ``depth`` frames
+  in flight, next_ advanced optimistically at send) or PROBE (ONE
+  frame in flight, entered on a reject or a transport failure):
+  after a follower detects a gap and rejects, exactly one catch-up
+  frame probes from the repair point instead of a window of doomed
+  resends.
+
+This object is pure bookkeeping — no I/O, no locks.  Every method is
+called under the owning server's lock; the deterministic pipeline
+tests drive it directly.
+"""
+
+from __future__ import annotations
+
+REPLICATE = "replicate"
+PROBE = "probe"
+
+
+class FrameMeta:
+    """One in-flight append frame's accounting record."""
+
+    __slots__ = ("seq", "epoch", "t0", "nbytes", "has_ents", "stripe")
+
+    def __init__(self, seq: int, epoch: int, t0: float, nbytes: int,
+                 has_ents: bool, stripe: int):
+        self.seq = seq
+        self.epoch = epoch
+        self.t0 = t0
+        self.nbytes = nbytes
+        self.has_ents = has_ents
+        self.stripe = stripe
+
+
+class _PeerPipe:
+    __slots__ = ("next_seq", "inflight", "mode", "last_send")
+
+    def __init__(self):
+        self.next_seq = 1
+        self.inflight: dict[int, FrameMeta] = {}
+        self.mode = REPLICATE
+        # per-STRIPE send stamps: heartbeat cadence is judged per
+        # stripe, because each stripe's frames reset election timers
+        # only on ITS lanes — one stripe's heartbeat must not
+        # satisfy the other's deadline
+        self.last_send: dict[int, float] = {}
+
+
+class AppendPipeline:
+    """Per-peer windowed send-stream state (module docstring)."""
+
+    def __init__(self, m: int, slot: int, depth: int):
+        if depth < 1:
+            raise ValueError(f"pipeline depth {depth} must be >= 1")
+        self.depth = depth
+        self.epoch = 1
+        self._peers = {p: _PeerPipe() for p in range(m) if p != slot}
+
+    # -- send side --------------------------------------------------------
+
+    def can_send(self, peer: int) -> bool:
+        pp = self._peers[peer]
+        if pp.mode == PROBE:
+            return not pp.inflight
+        return len(pp.inflight) < self.depth
+
+    def register(self, peer: int, *, t0: float, nbytes: int,
+                 has_ents: bool, stripe: int) -> FrameMeta:
+        """Allocate the next seq for ``peer`` and record the frame as
+        in flight; the caller stamps (seq, epoch) into the frame and
+        hands it to the transport."""
+        pp = self._peers[peer]
+        seq = pp.next_seq
+        pp.next_seq = (seq + 1) & 0x7FFFFFFF or 1
+        meta = FrameMeta(seq, self.epoch, t0, nbytes, has_ents,
+                         stripe)
+        pp.inflight[seq] = meta
+        pp.last_send[stripe] = t0
+        return meta
+
+    def last_send(self, peer: int, stripe: int = 0) -> float:
+        return self._peers[peer].last_send.get(stripe, 0.0)
+
+    def inflight(self, peer: int) -> int:
+        return len(self._peers[peer].inflight)
+
+    def inflight_total(self) -> int:
+        return sum(len(pp.inflight) for pp in self._peers.values())
+
+    def mode(self, peer: int) -> str:
+        return self._peers[peer].mode
+
+    # -- ack side ---------------------------------------------------------
+
+    def ack(self, peer: int, seq: int,
+            epoch: int) -> tuple[str, FrameMeta | None]:
+        """Match one response to its in-flight frame.  Returns
+        ``("ok", meta)`` or ``(reason, None)`` where reason is
+        ``stale_epoch`` (response from a previous leadership reign —
+        its progress content must NOT be absorbed) or ``stale_seq``
+        (duplicate or already-failed frame)."""
+        if epoch != self.epoch:
+            return "stale_epoch", None
+        meta = self._peers[peer].inflight.pop(seq, None)
+        if meta is None:
+            return "stale_seq", None
+        return "ok", meta
+
+    def note_reject(self, peer: int) -> None:
+        """A lane in a matched response rejected: the follower found
+        a gap (out-of-order or dropped frame).  Collapse to PROBE so
+        the repair goes out as ONE catch-up frame, not a window of
+        doomed optimistic sends."""
+        self._peers[peer].mode = PROBE
+
+    def note_ok(self, peer: int) -> None:
+        """A matched response appended cleanly: (re)open the window."""
+        self._peers[peer].mode = REPLICATE
+
+    def fail(self, peer: int, seqs) -> list[FrameMeta]:
+        """Transport failure: the listed frames will never be acked.
+        Pops them, enters PROBE; the caller rolls ``next_`` back to
+        ``match + 1`` (DistMember.probe_reset) and the next pump
+        sends one probe frame from the confirmed point."""
+        pp = self._peers[peer]
+        popped = [pp.inflight.pop(s) for s in seqs
+                  if s in pp.inflight]
+        if popped:
+            pp.mode = PROBE
+        return popped
+
+    def expire(self, now: float,
+               max_age: float) -> dict[int, list[FrameMeta]]:
+        """Backstop sweep: frames in flight longer than ``max_age``
+        can no longer be trusted to ack or fail (a transport edge
+        case that lost both).  Pops them per peer and enters PROBE —
+        the caller rolls next_ back and resends.  Safe because
+        redelivery is at-least-once by contract; a late ack for an
+        expired seq reads stale_seq and is dropped."""
+        out: dict[int, list[FrameMeta]] = {}
+        for peer, pp in self._peers.items():
+            stale = [s for s, m in pp.inflight.items()
+                     if now - m.t0 > max_age]
+            if stale:
+                out[peer] = [pp.inflight.pop(s) for s in stale]
+                pp.mode = PROBE
+        return out
+
+    # -- leadership transitions -------------------------------------------
+
+    def bump_epoch(self) -> int:
+        """The local leadership set changed (won or lost lanes): all
+        in-flight frames belong to the old reign.  Drop them, bump
+        the epoch (so their late acks read stale_epoch), and re-probe
+        every peer.  Returns how many frames were dropped."""
+        dropped = 0
+        self.epoch = (self.epoch + 1) & 0x7FFFFFFF or 1
+        for pp in self._peers.values():
+            dropped += len(pp.inflight)
+            pp.inflight.clear()
+            pp.mode = PROBE
+        return dropped
+
+
+__all__ = ["AppendPipeline", "FrameMeta", "PROBE", "REPLICATE"]

@@ -9,16 +9,36 @@ as ONE binary frame of [G] arrays (the batched analog: same
 fire-and-forget, drop-tolerant contract, server.go:202-206, but the
 unit of transport is the whole group batch).
 
-Frame = 16-byte header + fixed [G] sections + payload table:
+Frame = 24-byte header + fixed [G] sections + payload table:
 
-  header:  magic "DGB1" | kind u8 | sender_slot u8 | flags u16 |
-           g u32 | e u32
-  body:    kind-specific little-endian arrays (see each class)
+  header:  magic "DGB2" | kind u8 | sender_slot u8 | flags u16 |
+           g u32 | e u32 | seq u32 | epoch u32
+  body:    kind-specific little-endian arrays (see each class);
+           i32 sections lead so every array lands 4-aligned, u8
+           masks trail
   payload: lens [sum(n_ents)] i32 + concatenated blobs (appends only)
+
+``seq``/``epoch`` are the PIPELINE tags (PR 5): the leader numbers
+every append frame per peer (seq) within a leadership epoch (bumped
+whenever the local leadership set changes), and the follower echoes
+both into its response — acks may then return OUT OF ORDER over
+striped connections and still be matched to the exact in-flight
+frame, with duplicate and stale-epoch responses rejected instead of
+corrupting progress state.  Vote frames carry zeros (the campaign
+round-trip stays lockstep).
 
 Arrays are raw numpy little-endian — the receiving end feeds them
 straight into the batched engine (raft/batched.py) without a decode
 loop: wire layout == device layout is the point.
+
+Copy discipline: ``marshal`` writes every section straight into ONE
+preallocated bytearray (no intermediate ``tobytes``/join garbage —
+at depth-8 pipelining the old form allocated ~10 temporaries per
+frame per peer), and ``unmarshal`` returns ``np.frombuffer`` views
+over the received buffer (read-only; the engine copies on device
+put).  Payload blobs are the one deliberate copy on unpack: they
+outlive the frame buffer in the host payload ring, and a memoryview
+would pin the whole frame.
 """
 
 from __future__ import annotations
@@ -28,8 +48,8 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-_MAGIC = b"DGB1"
-_HDR = struct.Struct("<4sBBHII")
+_MAGIC = b"DGB2"
+_HDR = struct.Struct("<4sBBHIIII")
 
 KIND_APPEND = 0
 KIND_APPEND_RESP = 1
@@ -42,32 +62,51 @@ class FrameError(Exception):
     pass
 
 
-def _i32(g: int, buf: memoryview, pos: int) -> tuple[np.ndarray, int]:
-    end = pos + 4 * g
-    if end > len(buf):
+def _view_i32(data, pos: int, n: int) -> tuple[np.ndarray, int]:
+    """Read-only [n] i32 view over the frame buffer (no copy)."""
+    end = pos + 4 * n
+    if end > len(data):
         raise FrameError("truncated i32 section")
-    return np.frombuffer(buf[pos:end], "<i4").copy(), end
+    return np.frombuffer(data, "<i4", count=n, offset=pos), end
 
 
-def _u8(g: int, buf: memoryview, pos: int) -> tuple[np.ndarray, int]:
-    end = pos + g
-    if end > len(buf):
+def _view_u8(data, pos: int, n: int) -> tuple[np.ndarray, int]:
+    end = pos + n
+    if end > len(data):
         raise FrameError("truncated u8 section")
-    return np.frombuffer(buf[pos:end], np.uint8).copy(), end
+    return np.frombuffer(data, np.uint8, count=n, offset=pos), end
 
 
-def _header(kind: int, sender: int, g: int, e: int = 0) -> bytes:
-    return _HDR.pack(_MAGIC, kind, sender, 0, g, e)
+def _w_i32(buf: bytearray, pos: int, arr) -> int:
+    """Write ``arr`` as little-endian i32 straight into ``buf`` at
+    ``pos`` (the preallocated-frame write path: one cast-assign into
+    a frombuffer view, no intermediate bytes object)."""
+    a = np.asarray(arr)
+    n = a.size
+    if n:
+        np.frombuffer(buf, "<i4", count=n, offset=pos)[:] = a.ravel()
+    return pos + 4 * n
 
 
-def parse_header(data: bytes) -> tuple[int, int, int, int]:
-    """Returns (kind, sender_slot, g, e); raises FrameError."""
+def _w_u8(buf: bytearray, pos: int, arr) -> int:
+    a = np.asarray(arr)
+    n = a.size
+    if n:
+        np.frombuffer(buf, np.uint8, count=n,
+                      offset=pos)[:] = a.ravel()
+    return pos + n
+
+
+def parse_header(data) -> tuple[int, int, int, int, int, int]:
+    """Returns (kind, sender_slot, g, e, seq, epoch); raises
+    FrameError."""
     if len(data) < _HDR.size:
         raise FrameError("short frame")
-    magic, kind, sender, _flags, g, e = _HDR.unpack_from(data)
+    magic, kind, sender, _flags, g, e, seq, epoch = \
+        _HDR.unpack_from(data)
     if magic != _MAGIC:
         raise FrameError("bad magic")
-    return kind, sender, g, e
+    return kind, sender, g, e, seq, epoch
 
 
 @dataclass
@@ -82,6 +121,7 @@ class AppendBatch:
     analog, raft.go:207-209, as a pull to keep round frames small).
     ``ent_terms[g, j]``: term of entry prev_idx[g]+1+j, j < n_ents[g].
     ``payloads[g][j]``: that entry's opaque payload bytes.
+    ``seq``/``epoch``: pipeline frame tags (module docstring).
     """
 
     sender: int
@@ -94,53 +134,75 @@ class AppendBatch:
     need_snap: np.ndarray   # [G] bool
     ent_terms: np.ndarray   # [G, E] i32
     payloads: list[list[bytes]] = field(default_factory=list)
+    seq: int = 0
+    epoch: int = 0
 
-    def marshal(self) -> bytes:
+    def marshal(self) -> bytearray:
         g = self.term.shape[0]
         e = self.ent_terms.shape[1] if self.ent_terms.size else 0
-        lens, blobs = [], []
+        n_ents = np.asarray(self.n_ents)
+        lens: list[int] = []
+        blob_total = 0
         for gi in range(g):
             row = self.payloads[gi] if self.payloads else []
-            for j in range(int(self.n_ents[gi])):
+            for j in range(int(n_ents[gi])):
+                ln = len(row[j]) if j < len(row) else 0
+                lens.append(ln)
+                blob_total += ln
+        out = bytearray(_HDR.size + (5 * g + g * e + len(lens)) * 4
+                        + 2 * g + blob_total)
+        _HDR.pack_into(out, 0, _MAGIC, KIND_APPEND, self.sender, 0,
+                       g, e, self.seq & 0xFFFFFFFF,
+                       self.epoch & 0xFFFFFFFF)
+        pos = _HDR.size
+        pos = _w_i32(out, pos, self.term)
+        pos = _w_i32(out, pos, self.prev_idx)
+        pos = _w_i32(out, pos, self.prev_term)
+        pos = _w_i32(out, pos, n_ents)
+        pos = _w_i32(out, pos, self.commit)
+        pos = _w_i32(out, pos, self.ent_terms)
+        pos = _w_i32(out, pos, np.asarray(lens, "<i4"))
+        pos = _w_u8(out, pos, self.active)
+        pos = _w_u8(out, pos, self.need_snap)
+        for gi in range(g):
+            row = self.payloads[gi] if self.payloads else []
+            for j in range(int(n_ents[gi])):
                 b = row[j] if j < len(row) else b""
-                lens.append(len(b))
-                blobs.append(b)
-        return b"".join([
-            _header(KIND_APPEND, self.sender, g, e),
-            np.asarray(self.term, "<i4").tobytes(),
-            np.asarray(self.prev_idx, "<i4").tobytes(),
-            np.asarray(self.prev_term, "<i4").tobytes(),
-            np.asarray(self.n_ents, "<i4").tobytes(),
-            np.asarray(self.commit, "<i4").tobytes(),
-            np.asarray(self.active, np.uint8).tobytes(),
-            np.asarray(self.need_snap, np.uint8).tobytes(),
-            np.ascontiguousarray(self.ent_terms, "<i4").tobytes(),
-            np.asarray(lens, "<i4").tobytes(),
-        ] + blobs)
+                out[pos:pos + len(b)] = b
+                pos += len(b)
+        return out
 
     @classmethod
-    def unmarshal(cls, data: bytes) -> "AppendBatch":
-        kind, sender, g, e = parse_header(data)
+    def unmarshal(cls, data) -> "AppendBatch":
+        kind, sender, g, e, seq, epoch = parse_header(data)
         if kind != KIND_APPEND:
             raise FrameError(f"kind {kind} != append")
-        buf = memoryview(data)
         pos = _HDR.size
-        term, pos = _i32(g, buf, pos)
-        prev_idx, pos = _i32(g, buf, pos)
-        prev_term, pos = _i32(g, buf, pos)
-        n_ents, pos = _i32(g, buf, pos)
-        commit, pos = _i32(g, buf, pos)
-        active, pos = _u8(g, buf, pos)
-        need_snap, pos = _u8(g, buf, pos)
-        ets, pos = _i32(g * e, buf, pos)
+        term, pos = _view_i32(data, pos, g)
+        prev_idx, pos = _view_i32(data, pos, g)
+        prev_term, pos = _view_i32(data, pos, g)
+        n_ents, pos = _view_i32(data, pos, g)
+        commit, pos = _view_i32(data, pos, g)
+        ets, pos = _view_i32(data, pos, g * e)
+        if (n_ents < 0).any():
+            # per-lane, not just the sum: one negative and one large
+            # positive lane cancel to a small total but would spin
+            # the payload loop for ~2^31 iterations before dying on
+            # an IndexError instead of a FrameError
+            raise FrameError("negative entry count")
         total = int(n_ents.sum())
-        lens, pos = _i32(total, buf, pos)
+        lens, pos = _view_i32(data, pos, total)
+        active, pos = _view_u8(data, pos, g)
+        need_snap, pos = _view_u8(data, pos, g)
+        buf = memoryview(data)
         payloads: list[list[bytes]] = []
         li = 0
         for gi in range(g):
             row = []
             for _ in range(int(n_ents[gi])):
                 ln = int(lens[li])
+                if ln < 0 or pos + ln > len(data):
+                    raise FrameError("truncated payload blob")
                 li += 1
                 row.append(bytes(buf[pos:pos + ln]))
                 pos += ln
@@ -149,7 +211,8 @@ class AppendBatch:
                    prev_term=prev_term, n_ents=n_ents, commit=commit,
                    active=active.astype(bool),
                    need_snap=need_snap.astype(bool),
-                   ent_terms=ets.reshape(g, e), payloads=payloads)
+                   ent_terms=ets.reshape(g, e), payloads=payloads,
+                   seq=seq, epoch=epoch)
 
 
 @dataclass
@@ -160,7 +223,8 @@ class AppendResp:
     reject, ignored.  ``hint[g]``: the follower's commit index — the
     leader repairs next_ to hint+1 on reject (faster than the
     reference's decrement-by-one probe, raft.go:464-470; safe because
-    the committed prefix always matches)."""
+    the committed prefix always matches).  ``seq``/``epoch`` echo the
+    AppendBatch this responds to (pipeline ack matching)."""
 
     sender: int
     term: np.ndarray    # [G] i32 follower term (leader steps down if >)
@@ -168,6 +232,8 @@ class AppendResp:
     acked: np.ndarray   # [G] i32
     hint: np.ndarray    # [G] i32
     active: np.ndarray  # [G] bool
+    seq: int = 0
+    epoch: int = 0
     # LOCAL-ONLY (never marshalled): lanes whose entries the engine
     # actually appended this frame.  ``ok`` also covers need_snap
     # positive acks, which carry no entries — the follower's persist
@@ -175,31 +241,34 @@ class AppendResp:
     # mask, not ``ok``.
     appended: np.ndarray | None = None
 
-    def marshal(self) -> bytes:
+    def marshal(self) -> bytearray:
         g = self.term.shape[0]
-        return b"".join([
-            _header(KIND_APPEND_RESP, self.sender, g),
-            np.asarray(self.term, "<i4").tobytes(),
-            np.asarray(self.ok, np.uint8).tobytes(),
-            np.asarray(self.acked, "<i4").tobytes(),
-            np.asarray(self.hint, "<i4").tobytes(),
-            np.asarray(self.active, np.uint8).tobytes(),
-        ])
+        out = bytearray(_HDR.size + 3 * 4 * g + 2 * g)
+        _HDR.pack_into(out, 0, _MAGIC, KIND_APPEND_RESP, self.sender,
+                       0, g, 0, self.seq & 0xFFFFFFFF,
+                       self.epoch & 0xFFFFFFFF)
+        pos = _HDR.size
+        pos = _w_i32(out, pos, self.term)
+        pos = _w_i32(out, pos, self.acked)
+        pos = _w_i32(out, pos, self.hint)
+        pos = _w_u8(out, pos, self.ok)
+        pos = _w_u8(out, pos, self.active)
+        return out
 
     @classmethod
-    def unmarshal(cls, data: bytes) -> "AppendResp":
-        kind, sender, g, _ = parse_header(data)
+    def unmarshal(cls, data) -> "AppendResp":
+        kind, sender, g, _e, seq, epoch = parse_header(data)
         if kind != KIND_APPEND_RESP:
             raise FrameError(f"kind {kind} != append_resp")
-        buf = memoryview(data)
         pos = _HDR.size
-        term, pos = _i32(g, buf, pos)
-        ok, pos = _u8(g, buf, pos)
-        acked, pos = _i32(g, buf, pos)
-        hint, pos = _i32(g, buf, pos)
-        active, pos = _u8(g, buf, pos)
+        term, pos = _view_i32(data, pos, g)
+        acked, pos = _view_i32(data, pos, g)
+        hint, pos = _view_i32(data, pos, g)
+        ok, pos = _view_u8(data, pos, g)
+        active, pos = _view_u8(data, pos, g)
         return cls(sender=sender, term=term, ok=ok.astype(bool),
-                   acked=acked, hint=hint, active=active.astype(bool))
+                   acked=acked, hint=hint,
+                   active=active.astype(bool), seq=seq, epoch=epoch)
 
 
 @dataclass
@@ -212,27 +281,28 @@ class VoteReq:
     lterm: np.ndarray   # [G] i32 candidate last term
     active: np.ndarray  # [G] bool
 
-    def marshal(self) -> bytes:
+    def marshal(self) -> bytearray:
         g = self.term.shape[0]
-        return b"".join([
-            _header(KIND_VOTE, self.sender, g),
-            np.asarray(self.term, "<i4").tobytes(),
-            np.asarray(self.last, "<i4").tobytes(),
-            np.asarray(self.lterm, "<i4").tobytes(),
-            np.asarray(self.active, np.uint8).tobytes(),
-        ])
+        out = bytearray(_HDR.size + 3 * 4 * g + g)
+        _HDR.pack_into(out, 0, _MAGIC, KIND_VOTE, self.sender, 0,
+                       g, 0, 0, 0)
+        pos = _HDR.size
+        pos = _w_i32(out, pos, self.term)
+        pos = _w_i32(out, pos, self.last)
+        pos = _w_i32(out, pos, self.lterm)
+        pos = _w_u8(out, pos, self.active)
+        return out
 
     @classmethod
-    def unmarshal(cls, data: bytes) -> "VoteReq":
-        kind, sender, g, _ = parse_header(data)
+    def unmarshal(cls, data) -> "VoteReq":
+        kind, sender, g, _e, _seq, _epoch = parse_header(data)
         if kind != KIND_VOTE:
             raise FrameError(f"kind {kind} != vote")
-        buf = memoryview(data)
         pos = _HDR.size
-        term, pos = _i32(g, buf, pos)
-        last, pos = _i32(g, buf, pos)
-        lterm, pos = _i32(g, buf, pos)
-        active, pos = _u8(g, buf, pos)
+        term, pos = _view_i32(data, pos, g)
+        last, pos = _view_i32(data, pos, g)
+        lterm, pos = _view_i32(data, pos, g)
+        active, pos = _view_u8(data, pos, g)
         return cls(sender=sender, term=term, last=last, lterm=lterm,
                    active=active.astype(bool))
 
@@ -246,33 +316,38 @@ class VoteResp:
     granted: np.ndarray  # [G] bool
     active: np.ndarray   # [G] bool
 
-    def marshal(self) -> bytes:
+    def marshal(self) -> bytearray:
         g = self.term.shape[0]
-        return b"".join([
-            _header(KIND_VOTE_RESP, self.sender, g),
-            np.asarray(self.term, "<i4").tobytes(),
-            np.asarray(self.granted, np.uint8).tobytes(),
-            np.asarray(self.active, np.uint8).tobytes(),
-        ])
+        out = bytearray(_HDR.size + 4 * g + 2 * g)
+        _HDR.pack_into(out, 0, _MAGIC, KIND_VOTE_RESP, self.sender,
+                       0, g, 0, 0, 0)
+        pos = _HDR.size
+        pos = _w_i32(out, pos, self.term)
+        pos = _w_u8(out, pos, self.granted)
+        pos = _w_u8(out, pos, self.active)
+        return out
 
     @classmethod
-    def unmarshal(cls, data: bytes) -> "VoteResp":
-        kind, sender, g, _ = parse_header(data)
+    def unmarshal(cls, data) -> "VoteResp":
+        kind, sender, g, _e, _seq, _epoch = parse_header(data)
         if kind != KIND_VOTE_RESP:
             raise FrameError(f"kind {kind} != vote_resp")
-        buf = memoryview(data)
         pos = _HDR.size
-        term, pos = _i32(g, buf, pos)
-        granted, pos = _u8(g, buf, pos)
-        active, pos = _u8(g, buf, pos)
+        term, pos = _view_i32(data, pos, g)
+        granted, pos = _view_u8(data, pos, g)
+        active, pos = _view_u8(data, pos, g)
         return cls(sender=sender, term=term,
                    granted=granted.astype(bool),
                    active=active.astype(bool))
 
 
-def unmarshal_any(data: bytes):
+def unmarshal_any(data):
     kind, *_ = parse_header(data)
-    return {KIND_APPEND: AppendBatch,
-            KIND_APPEND_RESP: AppendResp,
-            KIND_VOTE: VoteReq,
-            KIND_VOTE_RESP: VoteResp}[kind].unmarshal(data)
+    try:
+        cls = {KIND_APPEND: AppendBatch,
+               KIND_APPEND_RESP: AppendResp,
+               KIND_VOTE: VoteReq,
+               KIND_VOTE_RESP: VoteResp}[kind]
+    except KeyError:
+        raise FrameError(f"unknown frame kind {kind}") from None
+    return cls.unmarshal(data)

@@ -58,6 +58,14 @@ def start(slot):
          "--data-dir", f"{BASE}/d{slot}", "--dist-slot", str(slot),
          "--dist-peers", ",".join(PEERS),
          "--cohosted-groups", "4",
+         # the recovery gates below are calibrated against a 2s
+         # worst-case election timeout (10 ticks x 0.1s x the
+         # [election, 2*election) band) — pinned explicitly because
+         # PR 4 raised the CLI default to 60 ticks (6-12s bands,
+         # sized for jit-compile first rounds on shared test boxes),
+         # which would make the 4s/5.5s gates unsatisfiable by
+         # construction
+         "--dist-election-ticks", "10",
          "--listen-client-urls", CLIENT[slot],
          "--advertise-client-urls", CLIENT[slot]],
         env=env, cwd=REPO,
@@ -99,7 +107,8 @@ def put_batch(slot, items, timeout=20):
         headers={"Content-Type": "application/octet-stream"})
     with urllib.request.urlopen(req, timeout=timeout) as r:
         out = json.loads(r.read())
-    return [bool(d.get("ok")) for d in out]
+    errs = out.get("errs", {})
+    return [str(i) not in errs for i in range(out["n"])]
 
 
 # key -> group coverage for the recovery probe (the 7 drill keys must

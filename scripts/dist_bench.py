@@ -24,6 +24,14 @@ comparison point is the (majority)-th fastest peer RTT + fsync.
 Prints ONE JSON line:
   JAX_PLATFORMS=cpu python scripts/dist_bench.py \
       [PROPOSALS] [CONNS] [WINDOW] [GROUPS]
+
+Pipeline-depth sweep (PR 5): ``--sweep`` runs the same workload at
+--dist-pipeline-depth 1/2/4/8/16 (depth=1 is the lockstep-equivalent
+baseline: one frame per peer in flight) on fresh clusters, emits one
+row per depth plus the ratios into ``bench_artifacts/``, and with
+``--check`` asserts the acceptance gate: pipelined ack p50 <= 1/4 of
+depth=1 and strictly higher proposals/s.  ``--smoke`` is the tiny
+loopback run wired into scripts/test.
 """
 
 import http.client
@@ -111,6 +119,45 @@ def fetch_ack_rtt(urls, timeout=5):
     return out
 
 
+def fetch_pipe_stats(urls, timeout=5):
+    """Pipeline forensics off /mraft/obs: frames shipped, resend/
+    drop reasons, coalesce batch shape — the row carries WHY a depth
+    behaved the way it did, not just the rates."""
+    frames = fails = 0
+    resend: dict[str, float] = {}
+    co_p50 = co_count = 0
+    for u in urls:
+        try:
+            with urllib.request.urlopen(u + "/mraft/obs",
+                                        timeout=timeout) as r:
+                snap = json.loads(r.read())
+        except Exception:
+            continue
+        for s in snap.get("etcd_peer_send_frames_total",
+                          {}).get("samples", []):
+            if s["labels"].get("path") == "dist":
+                frames += s["value"]
+        for s in snap.get("etcd_peer_send_failures_total",
+                          {}).get("samples", []):
+            if s["labels"].get("path") == "dist":
+                fails += s["value"]
+        for s in snap.get("etcd_dist_frame_resend_total",
+                          {}).get("samples", []):
+            reason = s["labels"].get("reason", "?")
+            resend[reason] = resend.get(reason, 0) + s["value"]
+        for s in snap.get("etcd_dist_coalesce_entries",
+                          {}).get("samples", []):
+            if s.get("count", 0) > co_count:
+                co_count, co_p50 = s["count"], s.get("p50", 0)
+    return {
+        "frames_sent": int(frames),
+        "frames_failed": int(fails),
+        "frame_resend": {k: int(v) for k, v in sorted(resend.items())},
+        "coalesce_p50_entries": co_p50,
+        "coalesce_flushes": co_count,
+    }
+
+
 def free_ports(n):
     socks, ports = [], []
     for _ in range(n):
@@ -126,7 +173,7 @@ def free_ports(n):
 CAP = int(os.environ.get("DIST_CAP", 1024))  # per-group log window
 
 
-def spawn(tmp, slot, urls):
+def spawn(tmp, slot, urls, depth=8):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
@@ -135,7 +182,8 @@ def spawn(tmp, slot, urls):
            "--data-dir", os.path.join(tmp, f"d{slot}"),
            "--slot", str(slot), "--peers", ",".join(urls),
            "--groups", str(G), "--cap", str(CAP),
-           "--max-batch-ents", "128"]
+           "--max-batch-ents", "128",
+           "--pipeline-depth", str(depth)]
     if slot == 0:
         cmd.append("--bootstrap")
     return subprocess.Popen(cmd, stdout=subprocess.PIPE,
@@ -154,18 +202,15 @@ def wait_ready(proc, timeout=180):
     raise AssertionError("node never became READY")
 
 
-def main() -> None:
-    global G
-    total = int(sys.argv[1]) if len(sys.argv) > 1 else 16000
-    conns = int(sys.argv[2]) if len(sys.argv) > 2 else 8
-    window = int(sys.argv[3]) if len(sys.argv) > 3 else 512
-    if len(sys.argv) > 4:
-        G = int(sys.argv[4])
+def run_once(total: int, conns: int, window: int,
+             depth: int = 8) -> dict:
+    import resource
 
+    cpu0 = resource.getrusage(resource.RUSAGE_CHILDREN)
     ports = free_ports(3)
     urls = [f"http://127.0.0.1:{p}" for p in ports]
     tmp = tempfile.mkdtemp()
-    procs = [spawn(tmp, s, urls) for s in range(3)]
+    procs = [spawn(tmp, s, urls, depth=depth) for s in range(3)]
     acked = [0] * conns
     try:
         for p in procs:
@@ -197,7 +242,7 @@ def main() -> None:
             resp = c.getresponse()
             out = json.loads(resp.read().decode())
             rtt = time.perf_counter() - bt0
-            ok = sum(1 for d in out if d.get("ok"))
+            ok = out["n"] - len(out["errs"])
             if ok:
                 with lat_lock:
                     lats.append((rtt, ok))
@@ -242,9 +287,12 @@ def main() -> None:
         dt = time.perf_counter() - t0
         done = sum(acked)
         rtt = fetch_ack_rtt(urls) or {}
-        print(json.dumps({
+        rtt.update(fetch_pipe_stats(urls))
+        row = {
             "hosts": 3, "groups": G, "conns": conns,
             "window": window,
+            "pipeline_depth": depth,
+            "lockstep_equivalent": depth == 1,
             # max client-side writes in flight: conns windows deep
             "in_flight": conns * window,
             **rtt,
@@ -261,7 +309,9 @@ def main() -> None:
             # behind the throughput number
             "ack_p50_ms": round(weighted_pct(lats, 0.5) * 1e3, 1),
             "ack_p99_ms": round(weighted_pct(lats, 0.99) * 1e3, 1),
-        }), flush=True)
+            "wall_s": round(dt, 2),
+        }
+        return row
     finally:
         for p in procs:
             try:
@@ -274,6 +324,111 @@ def main() -> None:
             except subprocess.TimeoutExpired:
                 p.kill()
         shutil.rmtree(tmp, ignore_errors=True)
+        try:
+            # only valid after the children are reaped (the waits
+            # above): total node CPU, incl. startup/jit — the
+            # cpu-per-acked-write column is what depth comparisons
+            # should be read against on a shared-core host
+            cpu1 = resource.getrusage(resource.RUSAGE_CHILDREN)
+            row["cluster_cpu_s"] = round(
+                cpu1.ru_utime + cpu1.ru_stime
+                - cpu0.ru_utime - cpu0.ru_stime, 2)
+            row["cluster_cpu_ms_per_acked"] = round(
+                1e3 * row["cluster_cpu_s"] / max(1, row["acked"]), 3)
+        except NameError:
+            pass  # failed before the row existed
+
+
+SWEEP_DEPTHS = (1, 2, 4, 8, 16)
+
+
+def run_sweep(total: int, conns: int, window: int, *,
+              check: bool, out_dir: str | None = None) -> dict:
+    """One row per pipeline depth on a FRESH cluster each (depth=1 is
+    the lockstep-equivalent baseline measured in the same session —
+    same host, same load, same code path, window of one)."""
+    rows = []
+    for depth in SWEEP_DEPTHS:
+        row = run_once(total, conns, window, depth=depth)
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+    base = next(r for r in rows if r["pipeline_depth"] == 1)
+    best = min(rows, key=lambda r: r["ack_p50_ms"])
+    art = {
+        "bench": "dist_pipeline_depth_sweep",
+        "proposals": total, "conns": conns, "window": window,
+        "rows": rows,
+        "baseline_depth1_ack_p50_ms": base["ack_p50_ms"],
+        "best_depth": best["pipeline_depth"],
+        "best_ack_p50_ms": best["ack_p50_ms"],
+        "ack_p50_speedup_vs_lockstep": round(
+            base["ack_p50_ms"] / best["ack_p50_ms"], 2)
+        if best["ack_p50_ms"] else None,
+        "proposals_per_sec_vs_lockstep": round(
+            best["proposals_per_sec"] / base["proposals_per_sec"], 2)
+        if base["proposals_per_sec"] else None,
+    }
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        ts = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        path = os.path.join(out_dir, f"dist_pipeline_sweep_{ts}.json")
+        with open(path, "w") as f:
+            json.dump(art, f, indent=1, sort_keys=True)
+        art["artifact"] = path
+    print(json.dumps({k: v for k, v in art.items() if k != "rows"}),
+          flush=True)
+    if check:
+        # the PR-5 acceptance gate, measured in ONE session
+        assert art["ack_p50_speedup_vs_lockstep"] >= 4.0, (
+            f"pipelined ack p50 speedup "
+            f"{art['ack_p50_speedup_vs_lockstep']} < 4x vs the "
+            f"depth=1 lockstep-equivalent run")
+        assert best["proposals_per_sec"] > base["proposals_per_sec"], (
+            "pipelining must raise throughput, not just hide latency")
+    return art
+
+
+def main() -> None:
+    global G
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("total", type=int, nargs="?", default=16000)
+    ap.add_argument("conns", type=int, nargs="?", default=8)
+    ap.add_argument("window", type=int, nargs="?", default=512)
+    ap.add_argument("groups", type=int, nargs="?", default=None)
+    ap.add_argument("--depth", type=int, default=8,
+                    help="--dist-pipeline-depth for a single run")
+    ap.add_argument("--sweep", action="store_true",
+                    help="run the pipeline-depth sweep "
+                         f"{SWEEP_DEPTHS} and write the artifact")
+    ap.add_argument("--check", action="store_true",
+                    help="with --sweep: assert the >=4x ack-p50 gate")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny loopback run for scripts/test: "
+                         "depth 1 vs 8, sanity-only assertions")
+    ap.add_argument("--out-dir",
+                    default=os.path.join(REPO, "bench_artifacts"))
+    args = ap.parse_args()
+    if args.groups is not None:
+        G = args.groups
+
+    if args.smoke:
+        # small enough for CI: proves the pipelined path commits,
+        # acks every proposal, and depth=1 still works (the
+        # lockstep-equivalent window); the 4x gate needs the full
+        # sweep's sample sizes, not a smoke run
+        for depth in (1, 8):
+            row = run_once(800, 4, 100, depth=depth)
+            print(json.dumps(row), flush=True)
+            assert row["acked"] == 800, row
+        return
+    if args.sweep:
+        run_sweep(args.total, args.conns, args.window,
+                  check=args.check, out_dir=args.out_dir)
+        return
+    print(json.dumps(run_once(args.total, args.conns, args.window,
+                              depth=args.depth)), flush=True)
 
 
 if __name__ == "__main__":

@@ -40,6 +40,10 @@ def main() -> None:
     ap.add_argument("--groups", type=int, default=8)
     ap.add_argument("--cap", type=int, default=64)
     ap.add_argument("--max-batch-ents", type=int, default=32)
+    ap.add_argument("--pipeline-depth", type=int, default=8,
+                    help="max in-flight append frames per peer "
+                         "(1 = lockstep-equivalent)")
+    ap.add_argument("--coalesce-us", type=int, default=2000)
     ap.add_argument("--bootstrap", action="store_true",
                     help="campaign for every group before READY")
     args = ap.parse_args()
@@ -49,7 +53,9 @@ def main() -> None:
                      g=args.groups, cap=args.cap,
                      max_batch_ents=args.max_batch_ents,
                      tick_interval=0.05, post_timeout=2.0,
-                     election=60)
+                     election=60,
+                     pipeline_depth=args.pipeline_depth,
+                     coalesce_us=args.coalesce_us)
     srv.start()
 
     # SIGUSR1 dumps the tracer span table to stdout (profiling a real

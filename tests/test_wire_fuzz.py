@@ -9,6 +9,7 @@ with breadth the table tests cannot reach.
 """
 
 import random
+import time
 
 import pytest
 
@@ -88,6 +89,143 @@ def test_roundtrip_fuzz(seed):
             back = type(msg).unmarshal(wire)
             assert back == msg, type(msg).__name__
             assert back.marshal() == wire  # re-encode is byte-stable
+
+
+# -- dist frames (wire/distmsg.py): the pipelined [G]-batched tier ---------
+
+
+def _dist_cases(rng):
+    import numpy as np
+
+    from etcd_tpu.wire.distmsg import (
+        AppendBatch,
+        AppendResp,
+        VoteReq,
+        VoteResp,
+    )
+
+    g = rng.choice([1, 3, 8])
+    e = rng.choice([1, 2, 5])
+    i32 = lambda lo=0, hi=1 << 20: np.asarray(  # noqa: E731
+        [rng.randrange(lo, hi) for _ in range(g)], np.int32)
+    mask = lambda: np.asarray(  # noqa: E731
+        [rng.random() < 0.5 for _ in range(g)], bool)
+    seq = rng.randrange(1 << 31)
+    epoch = rng.randrange(1 << 31)
+    n_ents = np.asarray([rng.randrange(e + 1) for _ in range(g)],
+                        np.int32)
+    payloads = [[_bytes(rng) for _ in range(int(n))] for n in n_ents]
+    yield AppendBatch(
+        sender=rng.randrange(4), term=i32(), prev_idx=i32(),
+        prev_term=i32(), n_ents=n_ents, commit=i32(), active=mask(),
+        need_snap=mask(),
+        ent_terms=np.asarray(
+            [[rng.randrange(1 << 20) for _ in range(e)]
+             for _ in range(g)], np.int32),
+        payloads=payloads, seq=seq, epoch=epoch)
+    yield AppendResp(sender=rng.randrange(4), term=i32(), ok=mask(),
+                     acked=i32(), hint=i32(), active=mask(),
+                     seq=seq, epoch=epoch)
+    yield VoteReq(sender=rng.randrange(4), term=i32(), last=i32(),
+                  lterm=i32(), active=mask())
+    yield VoteResp(sender=rng.randrange(4), term=i32(),
+                   granted=mask(), active=mask())
+
+
+def _dist_eq(a, b) -> bool:
+    import numpy as np
+
+    if type(a) is not type(b):
+        return False
+    for f in a.__dataclass_fields__:
+        if f == "appended":
+            continue  # local-only, never marshalled
+        x, y = getattr(a, f), getattr(b, f)
+        if isinstance(x, np.ndarray):
+            if not np.array_equal(np.asarray(x, np.int64),
+                                  np.asarray(y, np.int64)):
+                return False
+        elif x != y:
+            return False
+    return True
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_dist_frame_roundtrip_fuzz(seed):
+    """Every dist frame kind survives marshal→unmarshal with the
+    seq/epoch header tags intact (the pipeline's ack matching rides
+    on them), and re-encoding is byte-stable — the zero-copy
+    preallocated-buffer marshal must produce the same bytes the
+    tobytes/join form did."""
+    from etcd_tpu.wire.distmsg import unmarshal_any
+
+    rng = random.Random(3000 + seed)
+    for _ in range(20):
+        for msg in _dist_cases(rng):
+            wire = bytes(msg.marshal())
+            back = unmarshal_any(wire)
+            assert _dist_eq(back, msg), type(msg).__name__
+            assert bytes(back.marshal()) == wire
+
+
+def test_dist_negative_lane_count_rejected_fast():
+    """Review regression: one negative + one large-positive n_ents
+    lane cancel to a small SUM, so a sum-only guard admits the frame
+    and the payload loop spins ~2^30 iterations before an IndexError
+    — the per-lane check must reject it as FrameError immediately."""
+    import struct
+
+    import numpy as np
+
+    from etcd_tpu.wire.distmsg import (
+        AppendBatch,
+        FrameError,
+        unmarshal_any,
+    )
+
+    g = 2
+    frame = AppendBatch(
+        sender=0, term=np.zeros(g, np.int32),
+        prev_idx=np.zeros(g, np.int32),
+        prev_term=np.zeros(g, np.int32),
+        n_ents=np.zeros(g, np.int32),
+        commit=np.zeros(g, np.int32),
+        active=np.ones(g, bool), need_snap=np.zeros(g, bool),
+        ent_terms=np.zeros((g, 1), np.int32),
+        payloads=[[], []])
+    wire = bytearray(frame.marshal())
+    n_ents_off = 24 + 3 * 4 * g  # header + term/prev_idx/prev_term
+    struct.pack_into("<ii", wire, n_ents_off, 1 << 30,
+                     -(1 << 30) + 5)
+    t0 = time.perf_counter()
+    with pytest.raises(FrameError):
+        unmarshal_any(bytes(wire))
+    assert time.perf_counter() - t0 < 1.0  # fails fast, no spin
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_dist_decoder_total_on_mutations(seed):
+    """Bit-flipped / truncated / extended dist frames never escape
+    the codec as anything but FrameError (the drop-tolerant peer
+    tier treats a bad frame as a dropped message — an unhandled
+    decoder exception would kill the handler thread instead)."""
+    from etcd_tpu.wire.distmsg import FrameError, unmarshal_any
+
+    rng = random.Random(4000 + seed)
+    for _ in range(30):
+        for msg in _dist_cases(rng):
+            wire = bytearray(msg.marshal())
+            op = rng.randrange(3)
+            if op == 0 and wire:
+                wire[rng.randrange(len(wire))] ^= 1 << rng.randrange(8)
+            elif op == 1 and wire:
+                del wire[rng.randrange(len(wire)):]
+            else:
+                wire += rng.randbytes(rng.randrange(1, 9))
+            try:
+                unmarshal_any(bytes(wire))
+            except FrameError:
+                pass  # the one allowed failure mode
 
 
 @pytest.mark.parametrize("seed", range(10))
