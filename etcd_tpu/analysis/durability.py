@@ -1,4 +1,5 @@
-"""durability-ordering: write → fsync before any return.
+"""durability-ordering: write → fsync before any return, and no
+delete before the superseding write is fsynced.
 
 The WAL/snapshot contract this tree's crash-recovery proofs lean on
 (torn-tail repair, never-acked-tail drop) is "acks only follow
@@ -9,6 +10,18 @@ to a normal ``return`` (or falling off the function end) must pass
 through a **sync** — ``.sync()``, ``os.fsync``, or a ``*fsync*``
 helper (dir-fsync after unlink/rename included).  ``raise`` paths are
 exempt: an exception is not an ack.
+
+**Deletion ordering** (PR 6, the segment-GC / snapshot-purge rule):
+an ``os.remove``/``os.unlink`` must never execute while an UNSYNCED
+write/rename is pending on the path — the artifact that supersedes
+the deleted one (the new snapshot, the repaired segment) must be
+durable BEFORE the old one goes, or a crash between the two leaves
+neither.  Reported as ``unsynced-delete`` at the remove site.
+Removes themselves do not arm this rule for later removes (purging
+N old snapshots needs one trailing dir fsync, not N interleaved
+ones — snapshots are independent files; the WAL's GC adds its own
+per-unlink dir fsync for seq contiguity, which this checker's
+return rule separately requires).
 
 Calls to other functions in the same module propagate: a call to a
 function that can exit dirty marks the caller dirty (fixpoint), so a
@@ -26,6 +39,10 @@ from .engine import Checker, Finding, dotted_name, iter_functions
 
 _MUTATING_OS = {"rename", "remove", "unlink", "truncate", "replace",
                 "ftruncate"}
+#: the subset whose execution-while-write-dirty is the deletion-
+#: ordering hazard (a superseded artifact removed before its
+#: successor is durable)
+_DELETING_OS = {"remove", "unlink"}
 
 #: receivers whose ``.write`` is a digest update, not a file write
 _NON_FILE_WRITE_RECV = ("crc", "digest", "hash")
@@ -36,11 +53,20 @@ def _last_component(node: ast.AST) -> str:
 
 
 class _PathState:
-    __slots__ = ("dirty", "op")
+    __slots__ = ("dirty", "op", "wdirty", "wop")
 
-    def __init__(self, dirty: bool = False, op: str = ""):
+    def __init__(self, dirty: bool = False, op: str = "",
+                 wdirty: bool = False, wop: str = ""):
         self.dirty = dirty
         self.op = op  # the mutating call that set dirty (last wins)
+        # write-dirty: an unsynced WRITE/rename (not a delete) is
+        # pending — the state the unsynced-delete rule checks at
+        # every remove/unlink site
+        self.wdirty = wdirty
+        self.wop = wop
+
+    def copy(self) -> "_PathState":
+        return _PathState(self.dirty, self.op, self.wdirty, self.wop)
 
 
 class _FnEval:
@@ -85,10 +111,21 @@ class _FnEval:
                        "durability"),
             detail=f"{where}:{op}")
 
+    def _delete_finding(self, line: int, del_op: str,
+                        wop: str) -> Finding:
+        return Finding(
+            checker=self.c.name, path=self.relpath, line=line,
+            rule="unsynced-delete", scope=self.scope,
+            message=(f"`{del_op}` runs while `{wop or 'a write'}` "
+                     "is not yet fsynced — the artifact superseding "
+                     "the deleted one must be durable before the "
+                     "old one goes (delete-after-fsync)"),
+            detail=f"delete:{del_op}<-{wop}")
+
     # -- expression classification ---------------------------------------
 
     def _call_effect(self, node: ast.Call) -> str:
-        """'sync' | 'dirty' | '' for one call node."""
+        """'sync' | 'write' | 'delete' | '' for one call node."""
         f = node.func
         name = dotted_name(f)
         leaf = name.split(".")[-1]
@@ -98,25 +135,46 @@ class _FnEval:
             recv = _last_component(f.value)
             if f.attr == "write" and not any(
                     k in recv for k in _NON_FILE_WRITE_RECV):
-                return "dirty"
+                return "write"
             if f.attr == "encode" and "encoder" in recv:
-                return "dirty"
+                return "write"
+            if name.startswith("os.") and f.attr in _DELETING_OS:
+                return "delete"
             if name.startswith("os.") and f.attr in _MUTATING_OS:
-                return "dirty"
-        # intra-module propagation by bare callee name
+                return "write"
+        # intra-module propagation by bare callee name: a callee
+        # that can exit dirty counts as an unsynced write at the
+        # call site (conservative — its pending bytes are whatever
+        # it left unsynced)
         if self.dirty_exit.get(leaf):
-            return "dirty"
+            return "write"
         return ""
 
     def _scan_expr(self, node: ast.AST, st: _PathState) -> None:
         for sub in ast.walk(node):
             if isinstance(sub, ast.Call):
                 eff = self._call_effect(sub)
-                if eff == "dirty":
+                if eff == "write":
                     st.dirty = True
+                    st.wdirty = True
                     st.op = dotted_name(sub.func) or st.op
+                    st.wop = st.op
+                elif eff == "delete":
+                    del_op = dotted_name(sub.func)
+                    if st.wdirty:
+                        # deletion ordering: the superseding write
+                        # is not durable yet at this unlink
+                        self.findings.append(self._delete_finding(
+                            getattr(sub, "lineno", self.fn.lineno),
+                            del_op, st.wop))
+                        st.wdirty = False  # reported once per path
+                    # a delete is still a mutation for the
+                    # exit-synced rule (dir entry must be fsynced)
+                    st.dirty = True
+                    st.op = del_op or st.op
                 elif eff == "sync":
                     st.dirty = False
+                    st.wdirty = False
 
     # -- statements ------------------------------------------------------
 
@@ -127,9 +185,14 @@ class _FnEval:
             if o.dirty:
                 st.op = o.op
                 break
+        st.wdirty = any(o.wdirty for o in outs)
+        for o in outs:
+            if o.wdirty:
+                st.wop = o.wop
+                break
 
     def _block_st(self, stmts, st_in: _PathState) -> _PathState:
-        st = _PathState(st_in.dirty, st_in.op)
+        st = st_in.copy()
         for stmt in stmts:
             self._stmt(stmt, st)
         return st
@@ -143,9 +206,11 @@ class _FnEval:
                     self._finding(stmt.lineno, "return", st.op))
                 self.exits_dirty = True
                 st.dirty = False  # reported once per path
+            st.wdirty = False
             return
         if isinstance(stmt, ast.Raise):
             st.dirty = False  # error propagation is not an ack
+            st.wdirty = False
             return
         if isinstance(stmt, ast.If):
             self._scan_expr(stmt.test, st)
@@ -157,10 +222,15 @@ class _FnEval:
             self._scan_expr(
                 stmt.iter if isinstance(stmt, (ast.For, ast.AsyncFor))
                 else stmt.test, st)
-            entry = _PathState(st.dirty, st.op)
+            entry = st.copy()
             body = self._block_st(stmt.body, entry)
-            after = _PathState(entry.dirty or body.dirty,
-                               body.op if body.dirty else entry.op)
+            # second-iteration check: re-running the body with the
+            # first pass's exit state catches a loop whose delete
+            # executes under dirt its OWN previous iteration left
+            # (e.g. remove-without-sync per segment)
+            self._block_st(stmt.body, body)
+            after = _PathState()
+            self._merge(after, entry, body)
             els = self._block_st(stmt.orelse, after)
             self._merge(st, entry, body, els)
             return
@@ -169,13 +239,14 @@ class _FnEval:
                 self._scan_expr(item.context_expr, st)
             out = self._block_st(stmt.body, st)
             st.dirty, st.op = out.dirty, out.op
+            st.wdirty, st.wop = out.wdirty, out.wop
             return
         if isinstance(stmt, ast.Try):
             body = self._block_st(stmt.body, st)
             outs = [body]
             for h in stmt.handlers:
-                pre = _PathState(st.dirty or body.dirty,
-                                 body.op if body.dirty else st.op)
+                pre = _PathState()
+                self._merge(pre, st, body)
                 outs.append(self._block_st(h.body, pre))
             els = self._block_st(stmt.orelse, body)
             merged = _PathState()
@@ -183,6 +254,7 @@ class _FnEval:
             if stmt.finalbody:
                 merged = self._block_st(stmt.finalbody, merged)
             st.dirty, st.op = merged.dirty, merged.op
+            st.wdirty, st.wop = merged.wdirty, merged.wop
             return
         if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.ClassDef)):

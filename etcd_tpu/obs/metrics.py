@@ -173,6 +173,24 @@ _DEFS = (
         "exceeding the pipeline's wall clock.", labels=("stage",),
         window=512),
     MetricDef(
+        "etcd_snap_stream_chunk_seconds", "histogram",
+        "Streamed snapshot install: receiver-side wall time per "
+        "chunk from request to verified (PR 6; fetch + rolling-CRC "
+        "verify over the peerlink channel).", window=512),
+    MetricDef(
+        "etcd_snap_install_total", "counter",
+        "Snapshot install/pull attempts by outcome: ok (installed) | "
+        "no_donor (no reachable donor host) | meta_failed (meta "
+        "fetch/parse error) | not_dominating (donor frontier behind "
+        "ours) | stream_failed (chunk stream aborted) | chunk_reject "
+        "(one per corrupt chunk rejected and refetched) | stale "
+        "(dominance lost between stream and install).",
+        labels=("outcome",)),
+    MetricDef(
+        "etcd_wal_segments_gc_total", "counter",
+        "WAL segment files deleted behind the durable snapshot "
+        "index (delete-after-fsync GC; the bounded-disk invariant)."),
+    MetricDef(
         "etcd_lint_findings", "gauge",
         "Findings per checker in the last static-analysis run "
         "(baselined findings included; suppressed ones not).",

@@ -19,12 +19,17 @@ transport:
   and dropped (``stale_seq``) — match_index only ever advances off a
   matched ack, and monotonically (the engine's progress_update is a
   max);
-- per peer the pipe is either REPLICATE (window of ``depth`` frames
-  in flight, next_ advanced optimistically at send) or PROBE (ONE
-  frame in flight, entered on a reject or a transport failure):
-  after a follower detects a gap and rejects, exactly one catch-up
-  frame probes from the repair point instead of a window of doomed
-  resends.
+- per peer the pipe is REPLICATE (window of ``depth`` frames in
+  flight, next_ advanced optimistically at send), PROBE (ONE frame
+  in flight, entered on a reject or a transport failure: after a
+  follower detects a gap and rejects, exactly one catch-up frame
+  probes from the repair point instead of a window of doomed
+  resends), or SNAPSHOT (PR 6: every lane the leader could send the
+  peer sits behind the compaction point, so NO append window can
+  help — one need-snap notification frame in flight at heartbeat
+  cadence while the peer streams the snapshot; a positive ack must
+  NOT reopen the window, only a pump that observes the peer past the
+  compaction point does).
 
 This object is pure bookkeeping — no I/O, no locks.  Every method is
 called under the owning server's lock; the deterministic pipeline
@@ -35,6 +40,7 @@ from __future__ import annotations
 
 REPLICATE = "replicate"
 PROBE = "probe"
+SNAPSHOT = "snapshot"
 
 
 class FrameMeta:
@@ -80,7 +86,7 @@ class AppendPipeline:
 
     def can_send(self, peer: int) -> bool:
         pp = self._peers[peer]
-        if pp.mode == PROBE:
+        if pp.mode != REPLICATE:  # PROBE and SNAPSHOT: one in flight
             return not pp.inflight
         return len(pp.inflight) < self.depth
 
@@ -130,22 +136,54 @@ class AppendPipeline:
         """A lane in a matched response rejected: the follower found
         a gap (out-of-order or dropped frame).  Collapse to PROBE so
         the repair goes out as ONE catch-up frame, not a window of
-        doomed optimistic sends."""
-        self._peers[peer].mode = PROBE
+        doomed optimistic sends.  A SNAPSHOT peer stays SNAPSHOT —
+        it is behind the compaction point, so probing cannot repair
+        it either; only the install can."""
+        pp = self._peers[peer]
+        if pp.mode != SNAPSHOT:
+            pp.mode = PROBE
 
     def note_ok(self, peer: int) -> None:
-        """A matched response appended cleanly: (re)open the window."""
-        self._peers[peer].mode = REPLICATE
+        """A matched response appended cleanly: (re)open the window.
+        SNAPSHOT is sticky here by design: a need-snap lane acks
+        POSITIVELY at its commit (distmember.handle_append), so an
+        ok ack proves nothing about the peer having crossed the
+        compaction point — only :meth:`note_caught_up` (called when a
+        pump-time build shows no need-snap lanes) reopens the
+        window."""
+        pp = self._peers[peer]
+        if pp.mode != SNAPSHOT:
+            pp.mode = REPLICATE
+
+    def note_snapshot(self, peer: int) -> None:
+        """Every sendable lane for this peer is behind the leader's
+        compaction point: stop building append windows (they would
+        all be doomed need-snap frames) and hold one notification
+        frame in flight at heartbeat cadence until the peer's
+        streamed install lands."""
+        self._peers[peer].mode = SNAPSHOT
+
+    def note_caught_up(self, peer: int) -> None:
+        """A pump-time build_append saw the peer past the compaction
+        point again (its streamed install landed and the positive
+        need-snap ack advanced match/next): leave SNAPSHOT via ONE
+        confirming probe frame rather than a full optimistic window
+        against a freshly-installed log."""
+        pp = self._peers[peer]
+        if pp.mode == SNAPSHOT:
+            pp.mode = PROBE
 
     def fail(self, peer: int, seqs) -> list[FrameMeta]:
         """Transport failure: the listed frames will never be acked.
-        Pops them, enters PROBE; the caller rolls ``next_`` back to
-        ``match + 1`` (DistMember.probe_reset) and the next pump
+        Pops them, enters PROBE (SNAPSHOT peers stay SNAPSHOT — a
+        lost notification frame changes nothing about the peer being
+        behind the compaction point); the caller rolls ``next_`` back
+        to ``match + 1`` (DistMember.probe_reset) and the next pump
         sends one probe frame from the confirmed point."""
         pp = self._peers[peer]
         popped = [pp.inflight.pop(s) for s in seqs
                   if s in pp.inflight]
-        if popped:
+        if popped and pp.mode != SNAPSHOT:
             pp.mode = PROBE
         return popped
 
@@ -163,7 +201,8 @@ class AppendPipeline:
                      if now - m.t0 > max_age]
             if stale:
                 out[peer] = [pp.inflight.pop(s) for s in stale]
-                pp.mode = PROBE
+                if pp.mode != SNAPSHOT:
+                    pp.mode = PROBE
         return out
 
     # -- leadership transitions -------------------------------------------
@@ -182,4 +221,5 @@ class AppendPipeline:
         return dropped
 
 
-__all__ = ["AppendPipeline", "FrameMeta", "PROBE", "REPLICATE"]
+__all__ = ["AppendPipeline", "FrameMeta", "PROBE", "REPLICATE",
+           "SNAPSHOT"]

@@ -35,6 +35,7 @@ import json
 import logging
 import os
 import queue
+import random
 import threading
 import time
 import urllib.error
@@ -49,6 +50,16 @@ from ..obs import metrics as _obs
 from ..obs.devledger import ledger as _ledger
 from ..raft.distmember import DistMember
 from ..snap import NoSnapshotError, Snapshotter
+from ..snap.stream import (
+    CHUNK_PATH as SNAP_CHUNK_PATH,
+    FRONTIER_PATH as SNAP_FRONTIER_PATH,
+    META_PATH as SNAP_META_PATH,
+    ChunkPuller,
+    SnapshotSource,
+    SnapStreamError,
+    SourceCache,
+    StaleSourceError,
+)
 from ..store import Store
 from ..utils.trace import tracer
 from ..utils.wait import Wait
@@ -116,7 +127,8 @@ class DistServer:
                  pipeline_depth: int = 8,
                  coalesce_us: int = 2000,
                  coalesce_ents: int = 512,
-                 coalesce_bytes: int = 1 << 20):
+                 coalesce_bytes: int = 1 << 20,
+                 snap_keep: int | None = None):
         self.slot = slot
         self.g, self.m = g, len(peer_urls)
         # live member slots (< m leaves spare slots for runtime
@@ -185,6 +197,45 @@ class DistServer:
         self._slot_ids: dict[int, int] = {}  # slot -> member id cache
         self._requeue: list[deque] = [deque() for _ in range(g)]
         self._need_pull = False      # snapshot catch-up requested
+        # Streamed-install retry state (PR 6): a failed pull re-arms
+        # _need_pull and backs off with jittered exponential delay
+        # across attempts (capped) instead of silently dropping the
+        # request — the wedge the monolithic pull had.  Guarded by
+        # self.lock.
+        self._pull_backoff = 0.0     # current base delay (0 = fresh)
+        self._pull_not_before = 0.0  # monotonic gate for next attempt
+        # per-donor store-size hints from the frontier probe: scales
+        # the meta-fetch timeout with the blob the donor must
+        # serialize before replying (round-loop/pull-thread only)
+        self._donor_size_hint: dict[int, int] = {}
+        # donor-side pinned snapshot serializations (chunk streams
+        # must serve one immutable byte stream per pull).  keep
+        # scales with the peer count: every OTHER member may lag
+        # concurrently (partition heal), and each pull pins its own
+        # stream — a fixed small keep would let them evict each
+        # other's pins mid-stream into stale/backoff churn
+        self._snap_sources = SourceCache(keep=max(2, self.m - 1))
+        # corruption-injection test hook (chaos drill): flip one byte
+        # of this chunk index the FIRST time it is served, proving
+        # the receiver rejects + refetches rather than installs
+        self._corrupt_chunk = int(os.environ.get(
+            "ETCD_SNAP_STREAM_CORRUPT_CHUNK", -1))
+        self._corrupted_once = False
+        # snapshot-at-threshold runs on the ROUND LOOP, outside
+        # self.lock (apply paths only raise this flag); _snap_mutex
+        # serializes direct snapshot() callers against it
+        self._want_snap = False
+        self._snap_mutex = threading.Lock()
+        # the deferred snapshot runs on its own thread (spawned and
+        # tracked by the round loop only): save_snap's write+fsync of
+        # a big store must not stall election ticks or leader pumps —
+        # the round loop IS the heartbeat source
+        self._snap_thread: threading.Thread | None = None
+        # the streamed pull runs off the round loop too (spawned and
+        # tracked by the round loop only): meta fetch + chunk stream
+        # of a big store block for minutes, and the round loop is the
+        # tick/heartbeat source for any lanes this host still leads
+        self._pull_thread: threading.Thread | None = None
         # one source of truth for election forensics (liveness beat +
         # campaign-lost logging), read once at construction
         self._debug_elections = bool(
@@ -261,7 +312,13 @@ class DistServer:
                 crc_fn = auto_crc32c
             except ImportError:
                 pass
-        self.ss = Snapshotter(self._snapdir, crc_fn=crc_fn)
+        from ..snap import DEFAULT_SNAP_KEEP
+
+        self.ss = Snapshotter(
+            self._snapdir, crc_fn=crc_fn,
+            keep=snap_keep if snap_keep is not None
+            else int(os.environ.get("ETCD_SNAP_KEEP",
+                                    DEFAULT_SNAP_KEEP)))
 
         self.seq = 0
         self.applied = np.zeros(g, np.int64)
@@ -559,6 +616,23 @@ class DistServer:
         for chan in list(self._channels.values()):
             chan.close()  # fails in-flight frames; done-guard drops
         self._pool.close()
+        # a deferred snapshot may still hold _snap_mutex mid-save;
+        # join it before closing the WAL (its cut/gc would raise on
+        # a closed file).  Same wedge rule as the round loop: if it
+        # won't exit, leave the WAL open.
+        snap_t = self._snap_thread
+        if snap_t is not None and snap_t.is_alive() \
+                and snap_t is not threading.current_thread():
+            snap_t.join(timeout=10)
+            loop_exited = loop_exited and not snap_t.is_alive()
+        # same rule for the deferred pull: its install does a WAL
+        # save under self.lock (the puller aborts promptly once done
+        # is set — the stream's abort hook polls it)
+        pull_t = self._pull_thread
+        if pull_t is not None and pull_t.is_alive() \
+                and pull_t is not threading.current_thread():
+            pull_t.join(timeout=10)
+            loop_exited = loop_exited and not pull_t.is_alive()
         if loop_exited:
             with self.lock:
                 self.wal.close()
@@ -569,9 +643,10 @@ class DistServer:
             # buffered between saves) but the caller must not reuse
             # the data dir in-process: two appenders would interleave
             # one segment's CRC chain.
-            log.warning("dist[%d]: stop(): round loop still running "
-                        "after join timeout; WAL left open — do not "
-                        "reuse this data dir in-process", self.slot)
+            log.warning("dist[%d]: stop(): round loop or deferred "
+                        "snapshot still running after join timeout; "
+                        "WAL left open — do not reuse this data dir "
+                        "in-process", self.slot)
         return loop_exited
 
     # -- durability helpers (call with self.lock held) --------------------
@@ -668,6 +743,13 @@ class DistServer:
         with tracer.span("dist.frame_unmarshal"):
             msg = unmarshal_any(data)
         with self.lock, tracer.span("dist.handle_frame"):
+            if self.done.is_set():
+                # stop() closes the WAL under this lock with done
+                # already set — refuse the frame BEFORE mutating
+                # engine state (the handler turns this into a quiet
+                # 503; the sender treats it as transport failure and
+                # probes on reconnect)
+                raise ServerStoppedError()
             if isinstance(msg, AppendBatch):
                 self.server_stats.recv_append()
                 with tracer.span("dist.handle_append"), \
@@ -696,6 +778,11 @@ class DistServer:
                 with tracer.span("dist.frame_persist"):
                     self._persist(recs)
                 if bool(np.any(msg.need_snap & msg.active)):
+                    if log.isEnabledFor(logging.DEBUG):
+                        log.debug("dist[%d]: need_snap frame from %d "
+                                  "lanes=%s", self.slot, msg.sender,
+                                  np.nonzero(msg.need_snap
+                                             & msg.active)[0].tolist())
                     self._need_pull = True
                 with tracer.span("dist.frame_apply"):
                     self._apply_committed()
@@ -717,22 +804,94 @@ class DistServer:
         r = Request.unmarshal(data)
         return self.do(r, timeout=timeout, forward=False)
 
+    def _snapshot_dict(self) -> dict:
+        """The snapshot payload fields (call with self.lock held)."""
+        return {
+            "store": self.store.save().decode(),
+            "frontier": [int(x) for x in self.applied],
+            "terms": [int(x) for x in
+                      self.mr.terms_at(self.applied).astype(int)],
+            "seq": self.seq,
+            "applied_total": self.raft_index,
+            # per-group live-membership at the frontier:
+            # conf changes below it need no entry replay
+            "members": np.asarray(self.mr.state.members)
+            .astype(int).tolist(),
+        }
+
     def snapshot_blob(self) -> bytes:
         """GET /mraft/snapshot: the current store + frontier (what a
-        lagging follower installs)."""
+        lagging follower installs; kept as the legacy monolithic
+        endpoint — diagnostics and the drill's frontier probe use
+        it)."""
         with self.lock:
-            return json.dumps({
-                "store": self.store.save().decode(),
-                "frontier": [int(x) for x in self.applied],
-                "terms": [int(x) for x in
-                          self.mr.terms_at(self.applied).astype(int)],
-                "seq": self.seq,
-                "applied_total": self.raft_index,
-                # per-group live-membership at the frontier:
-                # conf changes below it need no entry replay
-                "members": np.asarray(self.mr.state.members)
-                .astype(int).tolist(),
-            }).encode()
+            d = self._snapshot_dict()
+        return json.dumps(d).encode()
+
+    def snapshot_frontier(self) -> bytes:
+        """GET /mraft/snapshot/frontier: the applied vector alone —
+        the receiver's cheap pre-pin dominance probe.  A meta pin
+        serializes + CRC-chains the whole store under the lock and
+        holds the blob pinned for the cache TTL; a donor that cannot
+        dominate must never be made to pay that."""
+        with self.lock:
+            frontier = [int(x) for x in self.applied]
+        # cheap size hint so the receiver can scale its meta-fetch
+        # timeout with the donor's store size (the pin serializes a
+        # blob of the same order as the newest durable snapshot; a
+        # FIXED meta timeout wedges every pull of a store big enough
+        # to out-serialize it — the chunk deadline is size-scaled
+        # for the same reason)
+        approx = 0
+        try:
+            newest = self.ss._snap_names()[0]
+            approx = os.path.getsize(os.path.join(self.ss.dir, newest))
+        except (NoSnapshotError, OSError):
+            pass
+        return json.dumps({"frontier": frontier,
+                           "approx_bytes": approx}).encode()
+
+    def snapshot_stream_meta(self) -> bytes:
+        """POST /mraft/snapshot/meta: pin a fresh snapshot
+        serialization and return its stream header (id, chunk CRC
+        chain, frontier).  Each pull pins its own immutable byte
+        stream — the live store mutates continuously, and chunk k
+        and k+1 must come from ONE serialization."""
+        with self.lock:
+            d = self._snapshot_dict()
+        payload = json.dumps(d).encode()
+        extra = {k: d[k] for k in ("frontier", "terms", "seq",
+                                   "applied_total", "members")}
+        src = self._snap_sources.pin(
+            SnapshotSource(payload, extra=extra))
+        log.info("dist[%d]: pinned snapshot stream %s (%d bytes, "
+                 "%d chunks)", self.slot, src.id, len(payload),
+                 src.n_chunks)
+        return json.dumps(src.meta()).encode()
+
+    def snapshot_stream_chunk(self, body: bytes) -> tuple[int, bytes]:
+        """POST /mraft/snapshot/chunk: serve one chunk of a pinned
+        stream.  404 for an unknown/expired pin (the receiver
+        refetches meta), 416 for an out-of-range index."""
+        try:
+            sid, k_s = body.decode().split()
+            k = int(k_s)
+        except ValueError:
+            return 400, b""
+        src = self._snap_sources.get(sid)
+        if src is None:
+            return 404, b""
+        if not (0 <= k < src.n_chunks):
+            return 416, b""
+        data = src.chunk(k)
+        if k == self._corrupt_chunk and not self._corrupted_once:
+            # test hook: one corrupted serve, then clean — the
+            # receiver must reject on the rolling CRC and refetch
+            self._corrupted_once = True
+            data = bytes(data[:-1]) + bytes([data[-1] ^ 0xFF])
+            log.warning("dist[%d]: TEST HOOK corrupted snapshot "
+                        "chunk %d on first serve", self.slot, k)
+        return 200, data
 
     # -- client path ------------------------------------------------------
 
@@ -978,12 +1137,48 @@ class DistServer:
             with self.lock:
                 # handle_frame sets the flag under the lock; an
                 # unlocked test-and-clear here could lose a pull
-                # request that lands between the read and the write
-                need_pull = self._need_pull
-                self._need_pull = False
+                # request that lands between the read and the write.
+                # The backoff gate (_arm_pull_retry) spaces attempts
+                # after failures — the flag itself is NEVER dropped
+                # on failure, only deferred.
+                need_pull = (self._need_pull
+                             and time.monotonic()
+                             >= self._pull_not_before
+                             and (self._pull_thread is None
+                                  or not self._pull_thread.is_alive()))
+                if need_pull:
+                    self._need_pull = False
             if need_pull:
-                self._pull_snapshot()
+                # off the round loop (same rule as the deferred
+                # snapshot below): the meta fetch + chunk stream of a
+                # big store block for minutes, and this thread is the
+                # tick/heartbeat source — an inline pull would cost
+                # leadership of every lane this host still leads
+                self._pull_thread = threading.Thread(
+                    target=self._pull_snapshot_bg,
+                    name=f"dist{self.slot}-pull", daemon=True)
+                self._pull_thread.start()
             self._leader_round(batch)
+            with self.lock:
+                # apply paths raise the flag under the lock; clear it
+                # under the lock too so a set landing between the read
+                # and the write can't be lost.  While a deferred
+                # snapshot is still running the flag stays SET (the
+                # in-flight save captured an older seq; the trigger
+                # re-fires once it finishes).
+                want_snap = (self._want_snap
+                             and (self._snap_thread is None
+                                  or not self._snap_thread.is_alive()))
+                if want_snap:
+                    self._want_snap = False
+            if want_snap:
+                # off the round loop: save_snap's write+fsync of a
+                # big store would stall ticks/heartbeats here long
+                # enough to lose leadership on every big snapshot
+                self._snap_thread = threading.Thread(
+                    target=self._snapshot_bg,
+                    name=f"dist{self.slot}-snap", daemon=True)
+                self._snap_thread.start()
 
         for p in batch:
             self.w.trigger(p.id, None)
@@ -1243,6 +1438,13 @@ class DistServer:
         # member slots (live < m) must not get idle socket threads
         chan = None
         commit = None
+        # SNAPSHOT-mode evidence (PR 6): does ANY stripe's build see
+        # a lane it could actually append to?  A peer whose every
+        # active lane sits behind the compaction point gets the
+        # window collapsed to one need-snap notification frame at
+        # heartbeat cadence — a full window of append frames would
+        # all be doomed while its streamed install runs.
+        saw_active = saw_appendable = False
         for stripe in range(self._n_stripes):
             mask = self._stripe_masks[stripe]
             while self.pipe.can_send(peer):
@@ -1255,6 +1457,10 @@ class DistServer:
                     break
                 n_ents = np.asarray(b.n_ents)
                 has_ents = bool(n_ents.any())
+                saw_active = True
+                if bool((np.asarray(b.active)
+                         & ~np.asarray(b.need_snap)).any()):
+                    saw_appendable = True
                 if (has_ents and self.pipe.inflight(peer)
                         and int(n_ents.sum()) < self._min_frame_ents):
                     # anti-fragmentation: a follower pays a full
@@ -1293,6 +1499,16 @@ class DistServer:
                 chan.send(meta.seq, payload, stripe)
                 if not has_ents:
                     break
+        if saw_active:
+            if not saw_appendable:
+                log.debug("dist[%d]: peer %d all lanes need-snap",
+                          self.slot, peer)
+                self.pipe.note_snapshot(peer)
+            else:
+                # the peer is past the compaction point on at least
+                # one lane again (its install landed): leave
+                # SNAPSHOT via one confirming probe frame
+                self.pipe.note_caught_up(peer)
         self._set_inflight(peer)
 
     def _on_pipe_resp(self, peer: int, seq: int, status: int,
@@ -1575,64 +1791,321 @@ class DistServer:
         if (fill > (mr.cap * 3) // 4).any():
             mr.compact()
         if self.raft_index - self._snapi > self.snap_count:
-            self.snapshot()
+            # deferred to the round loop: _apply_committed runs
+            # under self.lock (round loop AND ack/handler threads),
+            # and snapshot()'s disk I/O must not run there
+            self._want_snap = True
 
     # -- snapshot / catch-up ----------------------------------------------
 
     def snapshot(self) -> None:
-        with tracer.span("dist.snapshot"):
-            self.ss.save_snap(Snapshot(
-                data=self.snapshot_blob(), index=self.seq,
-                term=self.raft_term))
-            self.mr.compact()
-            self.wal.cut()
-        self._snapi = self.raft_index
-        log.info("dist[%d]: snapshot at seq=%d", self.slot, self.seq)
+        """Durable snapshot → engine compaction → WAL cut → segment
+        GC (PR 6).  Crash-ordering: save_snap fsyncs the snapshot
+        file AND its directory entry before returning (the PR 1
+        invariant), so by the time gc() unlinks segments the
+        superseding artifact is durable — a crash anywhere in this
+        sequence restarts either from the old chain (snapshot saved,
+        nothing deleted yet) or from a seq-contiguous suffix still
+        covering the GC boundary (gc removes oldest-first with a
+        dir fsync per unlink).  The boundary is the OLDEST retained
+        snapshot's index, not the newest: load() must be able to
+        fall back across the whole retention window and replay
+        forward from whichever snapshot survives.
+
+        Lock discipline: only the state capture and the WAL/engine
+        mutations hold ``self.lock`` — the snapshot file's
+        write+fsync+purge (the seconds-long part on a big store)
+        runs OUTSIDE it, so peer frames and client ops don't stall
+        behind snapshot disk I/O; ``_snap_mutex`` serializes
+        concurrent snapshot() calls instead."""
+        with self._snap_mutex:
+            with self.lock:
+                snap_seq = self.seq
+                # only the tree->dict capture (store.save) needs the
+                # lock; the outer dumps re-escapes the whole embedded
+                # store string — comparable cost again — and must not
+                # stall handlers/round loop for it
+                d = self._snapshot_dict()
+                term = self.raft_term
+            blob = json.dumps(d).encode()
+            with tracer.span("dist.snapshot"):
+                # only this process's snapshot() writes the snap dir,
+                # and _snap_mutex is held: safe outside self.lock
+                self.ss.save_snap(Snapshot(
+                    data=blob, index=snap_seq, term=term))
+                with self.lock:
+                    self.mr.compact()
+                    if log.isEnabledFor(logging.DEBUG):
+                        log.debug(
+                            "dist[%d]: post-compact offset=%s "
+                            "applied=%s lead=%s", self.slot,
+                            np.asarray(self.mr.state.offset).tolist(),
+                            np.asarray(self.mr.state.applied).tolist(),
+                            np.asarray(self.mr.is_leader())
+                            .astype(int).tolist())
+                    self.wal.cut()
+                    floor = self.ss.retained_floor()
+                    self.wal.gc(snap_seq if floor is None else floor)
+            self._snapi = self.raft_index
+        log.info("dist[%d]: snapshot at seq=%d", self.slot, snap_seq)
+
+    def _snapshot_bg(self) -> None:
+        """Thread body for the round-loop-deferred snapshot: never
+        let a snapshot failure kill the thread loudly mid-shutdown
+        (stop() closes the WAL after joining us, but a crashed donor
+        disk etc. must surface as a log line, not a lost thread)."""
+        try:
+            self.snapshot()
+        except Exception:
+            if not self.done.is_set():
+                log.exception("dist[%d]: deferred snapshot failed",
+                              self.slot)
+
+    @staticmethod
+    def _install_ctr(outcome: str):
+        # the one copy of the outcome-counter lookup lives with the
+        # stream module (it bills chunk_reject there)
+        from ..snap.stream import _install_ctr
+
+        return _install_ctr(outcome)
+
+    def _pull_snapshot_bg(self) -> None:
+        """Thread body for the round-loop-deferred pull: any
+        unexpected failure (a donor bug the typed guards missed)
+        must re-arm with backoff and log — a raise here would kill
+        the thread silently and drop the pull request."""
+        try:
+            self._pull_snapshot()
+        except Exception:
+            if not self.done.is_set():
+                log.exception("dist[%d]: snapshot pull failed",
+                              self.slot)
+                self._arm_pull_retry()
+
+    def _arm_pull_retry(self) -> None:
+        """Re-arm the pull with jittered exponential backoff: the
+        need is NOT dropped on an all-donors-failed attempt (the
+        pre-PR-6 wedge — a lagging peer sat stuck until an
+        unrelated need_snap frame happened to re-trigger it)."""
+        with self.lock:
+            self._need_pull = True
+            base = max(0.25, self.post_timeout)
+            self._pull_backoff = min(
+                30.0, self._pull_backoff * 2 or base)
+            delay = self._pull_backoff * random.uniform(0.5, 1.5)
+            self._pull_not_before = time.monotonic() + delay
+        log.info("dist[%d]: snapshot pull failed on every donor; "
+                 "retrying in %.2fs", self.slot, delay)
+
+    def _fetch_snap_meta(self, h: int) -> dict | None:
+        """Meta pin fetch.  NOT on the shared keep-alive pool: the
+        donor serializes + CRC-chains its whole store before
+        replying, which on a big snapshot takes far longer than the
+        pool's post_timeout read deadline — a short meta timeout
+        would make large-snapshot pulls (the very case the stream
+        exists for) unable to get past step one."""
+        req = urllib.request.Request(
+            self.peer_urls[h] + SNAP_META_PATH, data=b"",
+            method="POST",
+            headers={"Content-Type": "application/octet-stream"})
+        # scale the wait with the donor's probed store size (1 MiB/s
+        # serialization floor on top of the fixed slack): a fixed
+        # timeout turns every donor of a big-enough store into
+        # "unreachable" at step one — all donors fail identically and
+        # the peer can never catch up, the wedge class this path
+        # exists to fix
+        hint_s = self._donor_size_hint.get(h, 0) / (1 << 20)
+        try:
+            with urllib.request.urlopen(
+                    req,
+                    timeout=max(30.0, 10 * self.post_timeout) + hint_s,
+                    context=self._peer_ssl_cli) as resp:
+                body = resp.read()
+        except (urllib.error.URLError, OSError):
+            return None  # unreachable donor
+        try:
+            return json.loads(body.decode())
+        except ValueError:
+            # the donor ANSWERED but with unparseable meta: a real
+            # failed attempt (donor-side bug), distinct from an
+            # unreachable donor — the documented meta_failed outcome
+            self._install_ctr("meta_failed").inc()
+            return None
+
+    def _fetch_snap_frontier(self, h: int) -> np.ndarray | None:
+        """Cheap pre-pin dominance probe (GET, no pin, no store
+        serialization on the donor)."""
+        try:
+            with urllib.request.urlopen(
+                    self.peer_urls[h] + SNAP_FRONTIER_PATH,
+                    timeout=max(2.0, self.post_timeout),
+                    context=self._peer_ssl_cli) as resp:
+                d = json.loads(resp.read().decode())
+            # remember the donor's size hint for the meta-fetch
+            # timeout (absent on peers without a durable snapshot)
+            self._donor_size_hint[h] = int(d.get("approx_bytes", 0))
+            return np.asarray(d["frontier"], np.int64)
+        except (urllib.error.URLError, OSError, ValueError,
+                KeyError, TypeError):
+            return None
+
+    def _stream_snapshot(self, h: int, meta: dict) -> bytes:
+        """Pull one pinned snapshot stream from donor ``h`` (chunked
+        over a peerlink channel, rolling-CRC verified, resume from
+        the last verified chunk on reconnect).  Raises
+        SnapStreamError/StaleSourceError."""
+        # the overall deadline must scale with the snapshot size: a
+        # fixed cap aborts every attempt on a big-snapshot/slow-link
+        # pull that is making steady progress (each retry starts over
+        # against a NEW pin, so the peer would never catch up — the
+        # exact wedge this path exists to fix).  120s of slack plus a
+        # 1 MiB/s average-throughput floor; genuine no-progress is
+        # the stall detector's job, not the deadline's.
+        deadline = 120.0 + int(meta.get("size", 0)) / (1 << 20)
+        puller = ChunkPuller(
+            self.peer_urls[h], meta,
+            ssl_context=self._peer_ssl_cli,
+            timeout=self.post_timeout,
+            window=4, deadline_s=deadline,
+            abort=self.done.is_set,
+            name=f"snap{self.slot}from{h}")
+        try:
+            return puller.run()
+        finally:
+            puller.close()
 
     def _pull_snapshot(self) -> None:
-        """Fetch + install the leader's snapshot (msgSnap-as-pull).
+        """Streamed snapshot install (PR 6; msgSnap-as-pull).
 
-        Installs only when the snapshot's frontier dominates our
-        applied vector — the store blob is the merged state of ALL
-        groups, so a partial install could regress groups that are
-        ahead; a uniformly-behind (fresh or restarted) member always
-        qualifies, which is the case the pull path exists for."""
+        Donors are tried in leader-hint order (then the remaining
+        peers): meta pin → dominance check → chunked stream →
+        install.  Installs only when the snapshot's frontier
+        dominates our applied vector — the store blob is the merged
+        state of ALL groups, so a partial install could regress
+        groups that are ahead; a uniformly-behind (fresh or
+        restarted) member always qualifies, which is the case the
+        pull path exists for.  A TRANSPORT-class failure (donor
+        unreachable, meta unreadable, stream aborted) re-arms
+        ``_need_pull`` with backoff instead of dropping it (the
+        pre-PR-6 wedge); a SNAPSHOT-class miss (not dominating,
+        rejected by every lane) does NOT re-arm — it means appends
+        are already flowing on lanes ahead of the pin, and the next
+        genuine need_snap frame re-sets the flag if a lane is still
+        behind the compaction point (an unconditional re-arm here
+        turns the benign already-caught-up case into an infinite
+        pull loop — found by the deep-lag drill)."""
         lead = self.mr.leader_hint()
-        hosts = {int(s) for s in lead if s >= 0 and s != self.slot}
-        for h in sorted(hosts):
-            try:
-                with urllib.request.urlopen(
-                        self.peer_urls[h] + "/mraft/snapshot",
-                        timeout=self.post_timeout * 5,
-                        context=self._peer_ssl_cli) as resp:
-                    blob = json.loads(resp.read().decode())
-            except (urllib.error.URLError, OSError,
-                    ValueError):
-                continue
-            frontier = np.asarray(blob["frontier"], np.int64)
-            terms = np.asarray(blob["terms"], np.int64)
-            members = None
-            if "members" in blob:
-                members = np.asarray(blob["members"], bool)
+        hinted = sorted({int(s) for s in lead
+                         if s >= 0 and s != self.slot})
+        rest = [p for p in range(self.m)
+                if p != self.slot and p not in hinted]
+        donors = hinted + rest
+        tried = 0
+        transport_failed = False
+        for h in donors:
+            if self.done.is_set():
+                return
+            # cheap dominance pre-probe BEFORE the meta pin: a pin
+            # makes the donor serialize + CRC-chain its whole store
+            # under its lock and hold the blob for the cache TTL —
+            # a spurious _need_pull on a caught-up peer must not
+            # cost every donor that (the probe is one small GET).
+            # Dominance is re-checked post-pin and again under the
+            # lock at install; this is only the cheap early exit.
+            probe = self._fetch_snap_frontier(h)
+            if probe is None:
+                continue  # unreachable donor: not an attempt
             with self.lock:
-                if not (frontier >= self.applied).all():
+                probe_dominates = bool((probe >= self.applied).all())
+            if not probe_dominates:
+                log.info("dist[%d]: donor %d frontier probe does "
+                         "not dominate; skipping without pin",
+                         self.slot, h)
+                self._install_ctr("not_dominating").inc()
+                tried += 1
+                continue
+            meta = self._fetch_snap_meta(h)
+            if meta is None:
+                continue  # unreachable donor: not an attempt
+            tried += 1
+            # one stale-pin retry per donor: the pin may have aged
+            # out (or the donor restarted) between meta and chunks
+            for attempt in range(2):
+                try:
+                    frontier = np.asarray(meta["frontier"], np.int64)
+                    terms = np.asarray(meta["terms"], np.int64)
+                    members = (np.asarray(meta["members"], bool)
+                               if "members" in meta else None)
+                    if frontier.shape != self.applied.shape:
+                        raise ValueError("frontier shape mismatch")
+                except (KeyError, TypeError, ValueError):
+                    # parseable JSON but not a stream header (donor
+                    # bug / version skew): the documented meta_failed
+                    # outcome — a bare KeyError here would kill the
+                    # pull thread instead of counting + backing off
+                    self._install_ctr("meta_failed").inc()
+                    transport_failed = True
+                    break
+                with self.lock:
+                    dominates = bool((frontier >= self.applied).all())
+                if not dominates:
                     log.info("dist[%d]: snapshot from %d does not "
                              "dominate; skipping", self.slot, h)
+                    self._install_ctr("not_dominating").inc()
+                    break
+                try:
+                    payload = self._stream_snapshot(h, meta)
+                except StaleSourceError:
+                    meta = self._fetch_snap_meta(h)
+                    if meta is None or attempt == 1:
+                        self._install_ctr("stream_failed").inc()
+                        transport_failed = True
+                        break
                     continue
-                inst = self.mr.install_snapshot(frontier, terms,
-                                                members=members)
-                if not inst.any():
-                    continue
-                self.store.recovery(blob["store"].encode())
-                self.applied = frontier.copy()
-                self.raft_index = blob.get("applied_total",
-                                           self.raft_index)
-                self.raft_term = max(self.raft_term,
-                                     int(terms.max()))
-                self._persist([])
-                log.info("dist[%d]: installed snapshot from host %d "
-                         "(%d lanes)", self.slot, h, int(inst.sum()))
-            return
+                except SnapStreamError as e:
+                    log.warning("dist[%d]: snapshot stream from %d "
+                                "failed: %s", self.slot, h, e)
+                    self._install_ctr("stream_failed").inc()
+                    transport_failed = True
+                    break
+                try:
+                    blob = json.loads(payload.decode())
+                except ValueError:
+                    # verified chunks but an unparseable payload:
+                    # donor-side serialization bug, not transport
+                    self._install_ctr("stream_failed").inc()
+                    break
+                with self.lock:
+                    # dominance re-checked under the lock: appends
+                    # absorbed during the (unlocked) stream may have
+                    # advanced us past this snapshot
+                    if not (frontier >= self.applied).all():
+                        self._install_ctr("stale").inc()
+                        break
+                    inst = self.mr.install_snapshot(
+                        frontier, terms, members=members)
+                    if not inst.any():
+                        self._install_ctr("stale").inc()
+                        break
+                    self.store.recovery(blob["store"].encode())
+                    self.applied = frontier.copy()
+                    self.raft_index = blob.get("applied_total",
+                                               self.raft_index)
+                    self.raft_term = max(self.raft_term,
+                                         int(terms.max()))
+                    self._persist([])
+                    self._pull_backoff = 0.0
+                    self._pull_not_before = 0.0
+                    log.info("dist[%d]: installed streamed snapshot "
+                             "from host %d (%d lanes, %d bytes)",
+                             self.slot, h, int(inst.sum()),
+                             len(payload))
+                self._install_ctr("ok").inc()
+                return
+        if tried == 0:
+            self._install_ctr("no_donor").inc()
+        if tried == 0 or transport_failed:
+            self._arm_pull_retry()
 
     # -- runtime membership (server.go:382-404, 542-559, per host) --------
 
@@ -1752,8 +2225,21 @@ def _make_peer_handler(server: DistServer):
         def do_POST(self):
             try:
                 if self.path == "/mraft":
-                    out = server.handle_frame(self._body())
+                    try:
+                        out = server.handle_frame(self._body())
+                    except ServerStoppedError:
+                        self._reply(503, b"")
+                        return
                     self._reply(200, out)
+                elif self.path == SNAP_META_PATH:
+                    # pin a fresh snapshot serialization; the reply
+                    # is the stream header (id + chunk CRC chain)
+                    self._body()
+                    self._reply(200, server.snapshot_stream_meta())
+                elif self.path == SNAP_CHUNK_PATH:
+                    code, data = server.snapshot_stream_chunk(
+                        self._body())
+                    self._reply(code, data)
                 elif self.path == "/mraft/propose":
                     try:
                         resp = server.handle_forward(
@@ -1803,6 +2289,8 @@ def _make_peer_handler(server: DistServer):
         def do_GET(self):
             if self.path == "/mraft/snapshot":
                 self._reply(200, server.snapshot_blob())
+            elif self.path == SNAP_FRONTIER_PATH:
+                self._reply(200, server.snapshot_frontier())
             elif self.path == "/mraft/obs":
                 # JSON registry snapshot (bucket counts + exact ring
                 # percentiles): the cross-process merge form —

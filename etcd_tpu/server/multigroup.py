@@ -155,7 +155,12 @@ class MultiGroupServer:
                 crc_fn = auto_crc32c
             except ImportError:
                 pass
-        self.ss = Snapshotter(self._snapdir, crc_fn=crc_fn)
+        from ..snap import DEFAULT_SNAP_KEEP
+
+        self.ss = Snapshotter(
+            self._snapdir, crc_fn=crc_fn,
+            keep=int(os.environ.get("ETCD_SNAP_KEEP",
+                                    DEFAULT_SNAP_KEEP)))
 
         self.seq = 0                      # global WAL entry sequence
         self.applied = np.zeros(g, np.int64)   # per-group applied idx
@@ -720,10 +725,18 @@ class MultiGroupServer:
             .astype(int).tolist(),
         }).encode()
         with tracer.span("mg.snapshot"):
-            self.ss.save_snap(Snapshot(data=blob, index=self.seq,
+            snap_seq = self.seq
+            self.ss.save_snap(Snapshot(data=blob, index=snap_seq,
                                        term=self.raft_term))
             mr.compact()
             self.wal.cut()
+            # snapshot is durable (save_snap fsyncs file+dir): WAL
+            # segments wholly behind the OLDEST retained snapshot
+            # can go — bounded disk under sustained traffic while
+            # load()'s corrupt-newest fallback keeps a replayable
+            # chain (PR 6; crash-ordering per WAL.gc)
+            floor = self.ss.retained_floor()
+            self.wal.gc(snap_seq if floor is None else floor)
         self._snapi = self.raft_index
         log.info("multigroup: snapshot at seq=%d (applied=%d)",
                  self.seq, self.raft_index)

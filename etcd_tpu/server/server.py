@@ -138,6 +138,18 @@ class WalSnapStorage:
     def cut(self) -> None:
         self.wal.cut()
 
+    def gc(self, index: int) -> int:
+        """Segment GC behind the DURABLE snapshot window (PR 6): the
+        run loop calls this right after ``save_snap`` returns — the
+        snapshotter fsyncs file+dir before returning, so the
+        delete-after-fsync ordering holds.  The boundary is the
+        OLDEST retained snapshot (not ``index``, the newest): the
+        corrupt-newest fallback ladder needs WAL coverage from
+        whichever kept snapshot load() lands on."""
+        floor = self.snapshotter.retained_floor()
+        return self.wal.gc(index if floor is None
+                           else min(index, floor))
+
 
 class EtcdServer:
     """Reference server.go:191-218."""
@@ -232,6 +244,16 @@ class EtcdServer:
             with tracer.span("server.persist"):
                 self.storage.save(rd.hard_state, rd.entries)
                 self.storage.save_snap(rd.snapshot)
+                if not is_empty_snap(rd.snapshot):
+                    # the snapshot just became durable (file + dir
+                    # fsync inside save_snap): segments wholly
+                    # behind it are dead weight — GC here, never
+                    # before the fsync (delete-after-fsync rule).
+                    # getattr: the Storage seam is duck-typed and
+                    # test recorders predate gc()
+                    gc = getattr(self.storage, "gc", None)
+                    if gc is not None:
+                        gc(rd.snapshot.index)
             for m in rd.messages:
                 if m.type == MSG_APP:
                     self.server_stats.send_append()
@@ -525,7 +547,11 @@ def new_server(cfg: ServerConfig, *, discoverer=None,
         except ImportError:
             log.warning("etcdserver: jax unavailable; host snapshot "
                         "hashing")
-    ss = Snapshotter(snapdir, crc_fn=crc_fn)
+    from ..snap import DEFAULT_SNAP_KEEP
+
+    ss = Snapshotter(snapdir, crc_fn=crc_fn,
+                     keep=int(os.environ.get("ETCD_SNAP_KEEP",
+                                             DEFAULT_SNAP_KEEP)))
     st = Store()
     m = cfg.cluster.find_name(cfg.name)
     waldir = os.path.join(cfg.data_dir, "wal")

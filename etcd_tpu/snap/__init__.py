@@ -3,12 +3,20 @@
 Reference snap/snapshotter.go.  The whole-file CRC is the device-hash
 target for large store snapshots (bench config 3); ``Snapshotter``
 accepts a pluggable ``crc_fn`` so the device kernel slots in behind the
-same seam.
+same seam.  ``stream`` (PR 6) adds the chunked, rolling-CRC-verified
+snapshot transfer the dist tier's deep-lag catch-up rides.
 """
 
-from .snapshotter import SnapEmptyError, Snapshotter, SnapCRCMismatchError, NoSnapshotError
+from .snapshotter import (
+    DEFAULT_SNAP_KEEP,
+    NoSnapshotError,
+    SnapCRCMismatchError,
+    SnapEmptyError,
+    Snapshotter,
+)
 
 __all__ = [
+    "DEFAULT_SNAP_KEEP",
     "Snapshotter",
     "NoSnapshotError",
     "SnapCRCMismatchError",

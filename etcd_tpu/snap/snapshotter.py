@@ -22,6 +22,14 @@ log = logging.getLogger(__name__)
 
 SNAP_SUFFIX = ".snap"
 
+#: snapshots retained after a successful save (newest-first); older
+#: files and quarantined ``.broken`` files beyond the window are
+#: purged so the snap dir stays bounded under sustained traffic
+#: (PR 6).  One durable snapshot would suffice for recovery; keeping
+#: a few preserves the load() fallback ladder against a corrupt
+#: newest file.
+DEFAULT_SNAP_KEEP = 5
+
 
 class SnapError(Exception):
     pass
@@ -49,9 +57,15 @@ class Snapshotter:
     (ops.crc_kernel.device_crc32c) drops in for large blobs."""
 
     def __init__(self, dirpath: str,
-                 crc_fn: Callable[[bytes], int] | None = None):
+                 crc_fn: Callable[[bytes], int] | None = None,
+                 keep: int = DEFAULT_SNAP_KEEP):
         self.dir = dirpath
         self.crc_fn = crc_fn or crc_value
+        if keep < 1:
+            raise ValueError(f"keep={keep} must be >= 1 (a purge "
+                             f"that deletes every snapshot would "
+                             f"strand the GC'd WAL chain)")
+        self.keep = keep
 
     def save_snap(self, snapshot: Snapshot) -> None:
         """No-op for empty snapshots (snapshotter.go:39-44)."""
@@ -73,6 +87,71 @@ class Snapshotter:
             f.flush()
             os.fsync(f.fileno())
         fsync_dir(self.dir)
+        # the NEW snapshot is durable (file + dir entry) — only now
+        # may older snapshots be deleted (delete-after-fsync; the
+        # durability-ordering checker's unsynced-delete rule)
+        self.purge()
+
+    def purge(self) -> None:
+        """Delete snapshots beyond the newest ``keep`` plus every
+        quarantined ``.broken`` file older than the newest snapshot.
+
+        Without this ``_snap_names`` grows forever under sustained
+        snapshotting.  Crash-safe at any point: snapshots are
+        independent files, so any surviving subset keeps load()
+        working as long as the newest (already fsynced by _save) is
+        present; a ``.broken`` newer than the newest kept snapshot is
+        retained so the quarantine evidence of a corrupt latest file
+        is not destroyed before an operator can see it."""
+        try:
+            names = os.listdir(self.dir)
+        except OSError:  # pragma: no cover - dir vanished
+            return
+        snaps = sorted((n for n in names if n.endswith(SNAP_SUFFIX)),
+                       reverse=True)
+        doomed = snaps[self.keep:]
+        if snaps:
+            newest_kept = snaps[0]
+            doomed += [n for n in names
+                       if n.endswith(".broken")
+                       and n[:-len(".broken")] < newest_kept]
+        if not doomed:
+            return
+        for n in doomed:
+            try:
+                os.remove(os.path.join(self.dir, n))
+            except OSError as e:  # pragma: no cover - racing purge
+                log.warning("snapshotter purge cannot remove %s: %s",
+                            n, e)
+        # unlinks must stick: a crash-reverted purge would regrow the
+        # dir and (worse) resurrect a .broken-masked ordering
+        fsync_dir(self.dir)
+        log.info("snapshotter: purged %d old snapshot file(s), "
+                 "%d kept", len(doomed), min(len(snaps), self.keep))
+
+    def retained_floor(self) -> int | None:
+        """Smallest raft index among the retained ``.snap`` files —
+        THE safe WAL-GC boundary.  Segments covering indexes at or
+        above this must survive: ``load()`` falls back across every
+        kept snapshot when the newest is corrupt, and the fallback
+        target needs WAL coverage from ITS index to replay forward.
+        GC'ing at the newest snapshot's index instead would make a
+        single corrupt newest file unrecoverable despite K-1 good
+        older snapshots (review finding, PR 6)."""
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return None
+        idxs = []
+        for n in names:
+            if not n.endswith(SNAP_SUFFIX):
+                continue
+            try:
+                _, _, idx_s = n[:-len(SNAP_SUFFIX)].partition("-")
+                idxs.append(int(idx_s, 16))
+            except ValueError:
+                continue
+        return min(idxs) if idxs else None
 
     def load(self) -> Snapshot:
         """Newest-first, falling back across corrupt files

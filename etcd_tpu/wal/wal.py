@@ -54,6 +54,7 @@ _LEN_STRUCT = struct.Struct("<q")
 _FSYNC_HIST = _obs.registry.histogram("etcd_wal_fsync_seconds")
 _APPEND_CTR = _obs.registry.counter("etcd_wal_append_entries_total")
 _CUT_CTR = _obs.registry.counter("etcd_wal_cuts_total")
+_GC_CTR = _obs.registry.counter("etcd_wal_segments_gc_total")
 
 
 def wal_name(seq: int, index: int) -> str:
@@ -497,6 +498,41 @@ class WAL:
         self.sync()
         fsync_dir(self.dir)
         _CUT_CTR.inc()
+
+    def gc(self, index: int) -> int:
+        """Delete segment files wholly behind ``index`` — the durable
+        snapshot index (PR 6 segment GC; the reference's
+        wal.ReleaseLockTo boundary).  Returns how many were removed.
+
+        The segment CONTAINING ``index`` is always kept: restart
+        replays from the snapshot index via ``select_segments``,
+        which needs a file whose start is <= index.  CALLER CONTRACT:
+        the snapshot superseding the deleted entries must already be
+        durable (file + dir fsync) — the snapshotter's ``_save`` does
+        exactly that before returning, and the durability checker's
+        unsynced-delete rule guards the ordering inside this module.
+
+        Crash-safe at any prefix: removal runs OLDEST-FIRST with a
+        directory fsync after EACH unlink, so any crash-surviving
+        subset is a seq-contiguous suffix still covering ``index``
+        (the same per-remove discipline as the torn-tail repair,
+        mirrored — that one removes newest-first to keep a contiguous
+        PREFIX)."""
+        names = sorted(check_wal_names(os.listdir(self.dir)))
+        i = search_index(names, index)
+        if not i:  # None (index below the chain) or 0: nothing behind
+            return 0
+        dfd = os.open(self.dir, os.O_RDONLY)
+        try:
+            for name in names[:i]:
+                os.remove(os.path.join(self.dir, name))
+                os.fsync(dfd)
+        finally:
+            os.close(dfd)
+        _GC_CTR.inc(i)
+        log.info("wal: gc removed %d segment(s) behind index %d "
+                 "(kept %s..)", i, index, names[i])
+        return i
 
     def sync(self) -> None:
         if self.f is not None:

@@ -20,6 +20,17 @@ and the snapshot-install loop (see distserver._ballot_record and
 distmember.handle_append).
 
 Usage: python scripts/chaos_drill.py [CYCLES]   (default 6)
+
+Deep-lag variant (PR 6): ``--deep-lag [WRITES]`` runs a different
+scenario — one member is killed, WRITES (default 2500) are driven
+past it with an aggressive snapshot cadence so the leader snapshots,
+compacts and GC's its WAL far beyond the victim's log, and ONE
+snapshot chunk is corrupted on first serve (donor-side injection).
+Gates: the rejoining victim catches up via STREAMED snapshot install
+(install-ok metric on the victim) within a bounded window, the
+corrupt chunk is rejected+refetched (never installed), zero acked
+writes are lost, and the survivors' WAL segment / snapshot counts
+stay at their fixed bounds.
 """
 import json
 import os
@@ -37,7 +48,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BASE = "/tmp/chaosd"
 PEERS = [f"http://127.0.0.1:1785{i}" for i in range(3)]
 CLIENT = [f"http://127.0.0.1:1486{i}" for i in range(3)]
-CYCLES = int(sys.argv[1]) if len(sys.argv) > 1 else 6
+_pos = [a for a in sys.argv[1:] if a.isdigit()]
+CYCLES = int(_pos[0]) if _pos else 6
+deep_lag = "--deep-lag" in sys.argv
 tear = "--tear" in sys.argv
 # --batch drives writes through POST /mraft/propose_many (the
 # pipelined do_many path) instead of single v2 PUTs — crash-tests the
@@ -52,12 +65,12 @@ env.update(JAX_PLATFORMS="cpu", ETCD_JAX_PLATFORMS="cpu",
            PYTHONPATH=f"{REPO}:/root/.axon_site")
 
 
-def start(slot):
+def start(slot, extra=()):
     return subprocess.Popen(
         [sys.executable, "-m", "etcd_tpu.cli", "--name", "chaos",
          "--data-dir", f"{BASE}/d{slot}", "--dist-slot", str(slot),
          "--dist-peers", ",".join(PEERS),
-         "--cohosted-groups", "4",
+         "--cohosted-groups", "4", *extra,
          # the recovery gates below are calibrated against a 2s
          # worst-case election timeout (10 ticks x 0.1s x the
          # [election, 2*election) band) — pinned explicitly because
@@ -132,6 +145,214 @@ KEYS = ["/c0/k", "/c2/k", "/c6/k", "/c9/k", "/c0/k2", "/c2/k2",
         "/c6/k2"]
 _covered = {group_of(k, N_GROUPS) for k in KEYS}
 assert _covered == set(range(N_GROUPS)), _covered
+
+# -- deep-lag recovery drill (PR 6) -----------------------------------------
+
+
+def fetch_obs(slot, timeout=5):
+    with urllib.request.urlopen(PEERS[slot] + "/mraft/obs",
+                                timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def obs_counter(snap, family, **labels):
+    total = 0.0
+    for s in snap.get(family, {}).get("samples", []):
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            total += s["value"]
+    return total
+
+
+def disk_counts(slot):
+    from etcd_tpu.utils.diskstat import wal_snap_usage
+
+    u = wal_snap_usage(f"{BASE}/d{slot}")
+    return u["wal_segments"], u["snap_files"]
+
+
+def deep_lag_drill(lag_writes: int) -> None:
+    """Kill → deep lag past the compaction point → streamed-install
+    rejoin, with a corrupt chunk injected donor-side."""
+    global procs
+    SNAP_COUNT = 250        # aggressive cadence: many GC cycles
+    CATCHUP_BOUND_S = 60.0  # rejoin gate (1-core shared host)
+    SNAP_KEEP = 3
+    env["ETCD_SNAP_STREAM_CORRUPT_CHUNK"] = "0"
+    env["ETCD_SNAP_CHUNK_BYTES"] = "65536"
+    env["ETCD_SNAP_KEEP"] = str(SNAP_KEEP)
+    extra = ["--snapshot-count", str(SNAP_COUNT)]
+    shutil.rmtree(BASE, ignore_errors=True)
+    os.makedirs(BASE, exist_ok=True)
+    procs = {i: start(i, extra) for i in range(3)}
+    issued = {}
+    try:
+        time.sleep(22)
+        deadline = time.time() + 60
+        for key in KEYS:
+            while True:
+                try:
+                    put(CLIENT[0], key, "warmup", timeout=3)
+                    issued.setdefault(key, set()).add("warmup")
+                    break
+                except Exception:
+                    if time.time() > deadline:
+                        raise RuntimeError("cluster failed to settle")
+                    time.sleep(0.5)
+        print("deep-lag: settled", flush=True)
+
+        victim = 2
+        survivors = [0, 1]
+        procs[victim].send_signal(signal.SIGKILL)
+        procs[victim].wait()
+        t0 = time.time()
+        write_deadline = t0 + 180.0
+        seq = acked = 0
+        # ACKED writes are the lag that matters (they advance the
+        # applied frontier the snapshot cadence counts); slot 0 is
+        # the bootstrap leader of every group, so batches go there —
+        # a batch refused by a mid-flap lane just retries
+        while acked < lag_writes and time.time() < write_deadline:
+            items = []
+            for _ in range(64):
+                seq += 1
+                key = f"{KEYS[seq % 7]}{seq % 17}"
+                val = f"v{seq}"
+                issued.setdefault(key, set()).add(val)
+                items.append((key, val))
+            try:
+                oks = put_batch(survivors[0], items, timeout=20)
+                acked += sum(oks)
+            except Exception:
+                time.sleep(0.2)
+        dt = time.time() - t0
+        print(f"deep-lag: {acked}/{seq} writes acked in {dt:.1f}s "
+              f"({acked / dt:.0f}/s) with s{victim} down",
+              flush=True)
+        assert acked >= lag_writes, \
+            f"only {acked}/{lag_writes} writes acked in 180s"
+
+        # the survivors must have snapshotted + GC'd while writing
+        gc_total = sum(
+            obs_counter(fetch_obs(s), "etcd_wal_segments_gc_total")
+            for s in survivors)
+        assert gc_total > 0, \
+            "no WAL segment GC ran — lag never crossed a snapshot"
+        for s in survivors:
+            segs, snaps = disk_counts(s)
+            print(f"deep-lag: s{s} disk: {segs} wal segments, "
+                  f"{snaps} snapshots", flush=True)
+            # GC keeps segments back to the OLDEST retained snapshot
+            # (the corrupt-newest fallback needs that coverage), so
+            # steady state is ~one segment per kept snapshot + the
+            # live one; +1 more: the probe races a live server (a
+            # just-saved snapshot exists for an instant before its
+            # purge, a cut lands before its gc)
+            assert segs <= SNAP_KEEP + 2, \
+                f"s{s} wal segments unbounded: {segs}"
+            assert snaps <= SNAP_KEEP + 1, \
+                f"s{s} snapshots unbounded: {snaps}"
+
+        # rejoin: the victim is far behind the compaction point and
+        # must catch up via the STREAMED install (not appends)
+        t_restart = time.time()
+        procs[victim] = start(victim, extra)
+
+        def view(base):
+            # absent-on-both is EQUAL (a key every write of which
+            # was rejected never committed anywhere); absent-on-one
+            # is divergence — an HTTPError must not abort the sweep
+            out = {}
+            for k in issued:
+                try:
+                    out[k] = get(base, k, timeout=5)["node"]["value"]
+                except urllib.error.HTTPError:
+                    out[k] = None
+            return out
+
+        caught = False
+        while time.time() - t_restart < CATCHUP_BOUND_S:
+            try:
+                if view(CLIENT[survivors[0]]) == view(CLIENT[victim]):
+                    caught = True
+                    break
+            except Exception:
+                pass
+            time.sleep(1.0)
+        catchup_s = time.time() - t_restart
+        if not caught:
+            # diagnostics before dying: per-host frontiers + the
+            # victim's install-outcome counters
+            for i in range(3):
+                try:
+                    with urllib.request.urlopen(
+                            PEERS[i] + "/mraft/snapshot",
+                            timeout=5) as r:
+                        d = json.loads(r.read())
+                    print(f"  s{i} frontier={d['frontier']} "
+                          f"applied_total={d.get('applied_total')}",
+                          flush=True)
+                except Exception as e:
+                    print(f"  s{i} frontier probe: "
+                          f"{type(e).__name__}", flush=True)
+            try:
+                vs = fetch_obs(victim).get(
+                    "etcd_snap_install_total", {})
+                print(f"  victim install outcomes: "
+                      f"{[(s['labels'], s['value']) for s in vs.get('samples', [])]}",
+                      flush=True)
+                sv, vv = view(CLIENT[survivors[0]]), \
+                    view(CLIENT[victim])
+                diffs = [k for k in issued if sv[k] != vv[k]]
+                print(f"  diverged keys: "
+                      f"{[(k, sv[k], vv[k]) for k in diffs[:6]]} "
+                      f"({len(diffs)} total)", flush=True)
+            except Exception as e:
+                print(f"  victim obs probe: {type(e).__name__}",
+                      flush=True)
+        assert caught, (f"victim not caught up within "
+                        f"{CATCHUP_BOUND_S}s")
+        print(f"deep-lag: victim caught up in {catchup_s:.1f}s "
+              f"(bound {CATCHUP_BOUND_S}s)", flush=True)
+
+        vobs = fetch_obs(victim)
+        installs = obs_counter(vobs, "etcd_snap_install_total",
+                               outcome="ok")
+        rejects = obs_counter(vobs, "etcd_snap_install_total",
+                              outcome="chunk_reject")
+        assert installs >= 1, \
+            "victim converged without a streamed snapshot install"
+        assert rejects >= 1, \
+            "injected corrupt chunk was never rejected"
+        print(f"deep-lag: streamed installs={installs:.0f}, "
+              f"corrupt chunks rejected+refetched={rejects:.0f}",
+              flush=True)
+
+        # zero lost writes: every key's value is SOME issued write
+        lost = []
+        for k, vals in issued.items():
+            try:
+                got = get(CLIENT[victim], k)["node"]["value"]
+            except urllib.error.HTTPError:
+                continue  # never committed
+            if got not in vals:
+                lost.append((k, got))
+        assert not lost, lost
+        print(f"DEEP-LAG DRILL CLEAN: {seq} writes past a dead "
+              f"member, streamed install with corrupt-chunk "
+              f"rejection, catch-up {catchup_s:.1f}s, "
+              f"zero lost writes", flush=True)
+    finally:
+        for p in procs.values():
+            try:
+                p.kill()
+            except Exception:
+                pass
+
+
+if deep_lag:
+    deep_lag_drill(int(_pos[0]) if _pos else 2500)
+    sys.exit(0)
+
 
 shutil.rmtree(BASE, ignore_errors=True)  # stale dirs from a prior
 # run would replay old values outside this run's issued set

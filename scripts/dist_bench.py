@@ -171,6 +171,9 @@ def free_ports(n):
 
 
 CAP = int(os.environ.get("DIST_CAP", 1024))  # per-group log window
+# snapshot cadence for the spawned nodes (0/unset = server default);
+# a saturation run with a small value exercises snapshot+GC inline
+SNAP_COUNT = int(os.environ.get("DIST_SNAP_COUNT", 0))
 
 
 def spawn(tmp, slot, urls, depth=8):
@@ -184,11 +187,32 @@ def spawn(tmp, slot, urls, depth=8):
            "--groups", str(G), "--cap", str(CAP),
            "--max-batch-ents", "128",
            "--pipeline-depth", str(depth)]
+    if SNAP_COUNT:
+        cmd += ["--snap-count", str(SNAP_COUNT)]
     if slot == 0:
         cmd.append("--bootstrap")
     return subprocess.Popen(cmd, stdout=subprocess.PIPE,
                             stderr=subprocess.DEVNULL, env=env,
                             text=True)
+
+
+def disk_usage(tmp):
+    """Per-cluster durable-state footprint (PR 6 bounded-disk
+    fields): total WAL/snap bytes across the 3 hosts and the MAX
+    per-host segment/snapshot file counts (the bound is per host)."""
+    from etcd_tpu.utils.diskstat import wal_snap_usage
+
+    out = {"wal_dir_bytes": 0, "snap_dir_bytes": 0,
+           "wal_segments_max": 0, "snap_files_max": 0}
+    for s in range(3):
+        u = wal_snap_usage(os.path.join(tmp, f"d{s}"))
+        out["wal_dir_bytes"] += u["wal_bytes"]
+        out["snap_dir_bytes"] += u["snap_bytes"]
+        out["wal_segments_max"] = max(out["wal_segments_max"],
+                                      u["wal_segments"])
+        out["snap_files_max"] = max(out["snap_files_max"],
+                                    u["snap_files"])
+    return out
 
 
 def wait_ready(proc, timeout=180):
@@ -288,6 +312,9 @@ def run_once(total: int, conns: int, window: int,
         done = sum(acked)
         rtt = fetch_ack_rtt(urls) or {}
         rtt.update(fetch_pipe_stats(urls))
+        rtt.update(disk_usage(tmp))
+        if SNAP_COUNT:
+            rtt["snap_count"] = SNAP_COUNT
         row = {
             "hosts": 3, "groups": G, "conns": conns,
             "window": window,

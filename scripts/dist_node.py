@@ -44,6 +44,9 @@ def main() -> None:
                     help="max in-flight append frames per peer "
                          "(1 = lockstep-equivalent)")
     ap.add_argument("--coalesce-us", type=int, default=2000)
+    ap.add_argument("--snap-count", type=int, default=None,
+                    help="applies between snapshots (snapshot + "
+                         "segment GC cadence; default 10000)")
     ap.add_argument("--bootstrap", action="store_true",
                     help="campaign for every group before READY")
     args = ap.parse_args()
@@ -55,7 +58,8 @@ def main() -> None:
                      tick_interval=0.05, post_timeout=2.0,
                      election=60,
                      pipeline_depth=args.pipeline_depth,
-                     coalesce_us=args.coalesce_us)
+                     coalesce_us=args.coalesce_us,
+                     snap_count=args.snap_count)
     srv.start()
 
     # SIGUSR1 dumps the tracer span table to stdout (profiling a real

@@ -1,14 +1,19 @@
-"""Long-running co-hosted-server soak: continuous mixed load, RSS
-and throughput sampled on a cadence — the stability/leak evidence a
-point-in-time suite cannot give.
+"""Long-running co-hosted-server soak: continuous mixed load, RSS,
+DISK and throughput sampled on a cadence — the stability/leak
+evidence a point-in-time suite cannot give.
 
-    python scripts/soak.py [MINUTES] [GROUPS]     (default 30, 256)
+    python scripts/soak.py [MINUTES] [GROUPS] [SNAP_COUNT]
+        (default 30, 256, 2000)
 
 Load mix per iteration: PUTs across G namespaces (round-robin), a
 GET, a periodic DELETE, a TTL key, and a watch register+fire+drain.
-Prints one status line per ~30 s (elapsed, ops, RSS) and a final
-JSON summary; nonzero exit on any op error or an RSS slope that
-doubles the post-warmup baseline.
+Prints one status line per ~30 s (elapsed, ops, RSS, WAL/snap dir
+bytes + file counts) and a final JSON summary; nonzero exit on any
+op error, an RSS slope that doubles the post-warmup baseline, or —
+the PR 6 bounded-disk gate — WAL segment / snapshot file counts
+exceeding their fixed bounds once snapshotting has begun (segment
+GC keeps at most the covering + current segments; retention keeps
+the newest K snapshots).
 """
 
 import json
@@ -20,6 +25,8 @@ import tempfile
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from etcd_tpu.utils.diskstat import wal_snap_usage as disk_sample  # noqa: E402
 
 
 def rss_mb() -> float:
@@ -46,6 +53,9 @@ def peak_rss_mb() -> float:
 def main() -> int:
     minutes = float(sys.argv[1]) if len(sys.argv) > 1 else 30.0
     g = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    # snapshot cadence: small enough that a saturation soak crosses
+    # it many times, so the bounded-disk gate actually bites
+    snap_count = int(sys.argv[3]) if len(sys.argv) > 3 else 2000
 
     import jax
 
@@ -55,7 +65,8 @@ def main() -> int:
     from etcd_tpu.wire.requests import Request
 
     d = tempfile.mkdtemp(prefix="soak")
-    srv = MultiGroupServer(d, g=g, m=3, cap=64)
+    srv = MultiGroupServer(d, g=g, m=3, cap=64,
+                           snap_count=snap_count)
     srv.start()
     rid = [0]
 
@@ -112,7 +123,8 @@ def main() -> int:
                 if baseline_rss is None and now - t0 > 120:
                     baseline_rss = cur  # post-warmup baseline
                 samples.append({"t_s": round(now - t0, 1),
-                                "ops": ops, "rss_mb": round(cur, 1)})
+                                "ops": ops, "rss_mb": round(cur, 1),
+                                **disk_sample(d)})
                 print(json.dumps(samples[-1]), flush=True)
                 next_report = now + 30
     finally:
@@ -120,10 +132,30 @@ def main() -> int:
             srv.stop()
         except Exception:
             pass
+        final_disk = disk_sample(d)
+        snapshots_taken = srv._snapi > 0
         shutil.rmtree(d, ignore_errors=True)
 
     final = rss_mb()
     leak = (baseline_rss is not None and final > 2 * baseline_rss)
+    # bounded-disk gate (PR 6): once snapshotting has run, segment
+    # GC and snapshot retention must hold the counts at their fixed
+    # bounds — unbounded growth under sustained traffic is the
+    # failure this subsystem exists to prevent
+    disk_bounded = True
+    # WAL bound: GC keeps segments back to the OLDEST retained
+    # snapshot (the corrupt-newest fallback needs that coverage), so
+    # the steady state is ~one segment per retained snapshot plus
+    # the live one (+1 mid-snapshot margin)
+    seg_bound = srv.ss.keep + 2
+    if snapshots_taken:
+        disk_bounded = (
+            final_disk["wal_segments"] <= seg_bound
+            and final_disk["snap_files"] <= srv.ss.keep)
+        if not disk_bounded:
+            print(f"DISK BOUND VIOLATED: {final_disk} "
+                  f"(bounds: wal_segments<={seg_bound}, "
+                  f"snap_files<={srv.ss.keep})", flush=True)
     # /metrics-equivalent snapshot (PR 2): the full obs ledger —
     # span histograms, wal fsync latency, apply batches, elections,
     # devledger transfer counters — rides the soak artifact, so a
@@ -137,7 +169,11 @@ def main() -> int:
         "rss_baseline_mb": round(baseline_rss or 0, 1),
         "rss_final_mb": round(final, 1),
         "rss_peak_mb": round(peak_rss_mb(), 1), "rss_doubled": leak,
-        "clean": errors == 0 and not leak,
+        "snap_count": snap_count,
+        "snapshots_taken": bool(snapshots_taken),
+        "disk_final": final_disk,
+        "disk_bounded": disk_bounded,
+        "clean": errors == 0 and not leak and disk_bounded,
         "metrics": obs_registry.snapshot(),
     }
     print(json.dumps(summary), flush=True)

@@ -498,6 +498,100 @@ def test_durability_quiet_when_paths_sync(tmp_path):
     assert scopes == {"W.buffered"}
 
 
+def test_durability_delete_before_superseding_fsync_fires(tmp_path):
+    """PR 6 deletion-ordering rule: an os.remove/unlink while an
+    unsynced write is pending (the superseding artifact not yet
+    durable) is the crash window that loses BOTH artifacts."""
+    root = _fixture_root(tmp_path, "etcd_tpu/snap/snapshotter.py", """
+        import os
+
+        class S:
+            def bad_purge(self, new, old, d):
+                with open(new, "wb") as f:
+                    f.write(b"snapshot")   # successor not fsynced...
+                os.remove(old)             # ...old one already gone
+                fd = os.open(d, os.O_RDONLY)
+                os.fsync(fd)
+                os.close(fd)
+
+            def bad_gc_rename(self, a, b, old):
+                os.rename(a, b)            # rename unsynced...
+                os.unlink(old)             # ...delete races it
+                os.fsync(self.dfd)
+    """)
+    findings = run_checkers(root, [DurabilityOrderingChecker()])
+    deletes = [f for f in findings if f.rule == "unsynced-delete"]
+    assert {f.scope for f in deletes} == {"S.bad_purge",
+                                          "S.bad_gc_rename"}
+
+
+def test_durability_delete_after_fsync_quiet(tmp_path):
+    """The correct orderings stay quiet: fsync of the superseding
+    artifact before every remove; a purge loop of independent
+    deletes with one trailing dir fsync; per-remove dir fsync in a
+    GC loop."""
+    root = _fixture_root(tmp_path, "etcd_tpu/wal/wal.py", """
+        import os
+
+        def fsync_dir(d):
+            fd = os.open(d, os.O_RDONLY)
+            os.fsync(fd)
+            os.close(fd)
+
+        class W:
+            def good_supersede(self, new, old, d):
+                with open(new, "wb") as f:
+                    f.write(b"x")
+                    f.flush()
+                    os.fsync(f.fileno())
+                fsync_dir(d)
+                os.remove(old)
+                fsync_dir(d)
+
+            def good_purge_loop(self, doomed, d):
+                # snapshots are independent files: N removes + ONE
+                # trailing dir fsync is a valid ordering (a delete
+                # must not arm the delete rule for later deletes)
+                for p in doomed:
+                    os.remove(p)
+                fsync_dir(d)
+
+            def good_gc_loop(self, names, d):
+                dfd = os.open(d, os.O_RDONLY)
+                for name in names:
+                    os.remove(name)
+                    os.fsync(dfd)
+                os.close(dfd)
+    """)
+    findings = run_checkers(root, [DurabilityOrderingChecker()])
+    assert not [f for f in findings if f.rule == "unsynced-delete"], \
+        [f.message for f in findings]
+    # and the exit-synced rule still holds on these fixtures too
+    assert not [f for f in findings if f.rule == "unsynced-return"], \
+        [f.message for f in findings]
+
+
+def test_durability_delete_dirty_from_callee_fires(tmp_path):
+    """Cross-function propagation: a call to a function that exits
+    with unsynced bytes counts as the pending write at a later
+    delete site."""
+    root = _fixture_root(tmp_path, "etcd_tpu/wal/wal.py", """
+        import os
+
+        class W:
+            def buffered(self, data):
+                self.f.write(data)        # exits dirty (baselined)
+
+            def bad_caller(self, data, old):
+                self.buffered(data)
+                os.remove(old)            # delete under callee dirt
+                os.fsync(self.f.fileno())
+    """)
+    findings = run_checkers(root, [DurabilityOrderingChecker()])
+    assert "W.bad_caller" in {f.scope for f in findings
+                              if f.rule == "unsynced-delete"}
+
+
 # -- 4b. device-boundary fires on seeded violations ---------------------------
 
 

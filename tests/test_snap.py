@@ -94,3 +94,70 @@ def test_custom_crc_fn_seam(tmp_path):
     ss.save_snap(SNAP)
     assert ss.load() == SNAP
     assert len(calls) == 2  # one save, one load
+
+
+# -- retention purge (PR 6): bounded snap dir ---------------------------------
+
+
+def test_purge_keeps_newest_k(tmp_path):
+    ss = Snapshotter(str(tmp_path), keep=3)
+    for i in range(1, 9):
+        ss.save_snap(Snapshot(data=b"v%d" % i, nodes=[1],
+                              index=i, term=1))
+    names = sorted(os.listdir(str(tmp_path)))
+    assert len(names) == 3          # _snap_names no longer grows
+    assert ss.load().data == b"v8"  # newest survives
+    assert names == [snap_name(1, i) for i in (6, 7, 8)]
+
+
+def test_purge_drops_old_broken_files(tmp_path):
+    ss = Snapshotter(str(tmp_path), keep=2)
+    ss.save_snap(Snapshot(data=b"old", nodes=[1], index=1, term=1))
+    # corrupt + quarantine the only snapshot
+    fpath = os.path.join(str(tmp_path), snap_name(1, 1))
+    blob = bytearray(open(fpath, "rb").read())
+    blob[-1] ^= 0xFF
+    open(fpath, "wb").write(bytes(blob))
+    import pytest as _pytest
+
+    with _pytest.raises(SnapCRCMismatchError):
+        ss.load()
+    assert snap_name(1, 1) + ".broken" in os.listdir(str(tmp_path))
+    # newer snapshots supersede the quarantine evidence: saving past
+    # it purges the old .broken
+    for i in (2, 3):
+        ss.save_snap(Snapshot(data=b"v%d" % i, nodes=[1],
+                              index=i, term=1))
+    names = os.listdir(str(tmp_path))
+    assert snap_name(1, 1) + ".broken" not in names
+    # a .broken NEWER than the newest kept snapshot is retained
+    # (operator evidence of a corrupt latest file)
+    open(os.path.join(str(tmp_path),
+                      snap_name(9, 9) + ".broken"), "wb").close()
+    ss.save_snap(Snapshot(data=b"v4", nodes=[1], index=4, term=1))
+    assert snap_name(9, 9) + ".broken" in os.listdir(str(tmp_path))
+
+
+def test_load_falls_back_past_corrupt_newest_after_purge(tmp_path):
+    """The satellite's regression: retention must not break the
+    fallback ladder — with keep>=2 a corrupt newest still falls back
+    to an older KEPT snapshot."""
+    ss = Snapshotter(str(tmp_path), keep=3)
+    for i in range(1, 6):
+        ss.save_snap(Snapshot(data=b"v%d" % i, nodes=[1],
+                              index=i, term=1))
+    # corrupt the newest survivor
+    fpath = os.path.join(str(tmp_path), snap_name(1, 5))
+    blob = bytearray(open(fpath, "rb").read())
+    blob[-1] ^= 0xFF
+    open(fpath, "wb").write(bytes(blob))
+    out = ss.load()
+    assert out.data == b"v4"
+    assert snap_name(1, 5) + ".broken" in os.listdir(str(tmp_path))
+
+
+def test_keep_below_one_rejected(tmp_path):
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        Snapshotter(str(tmp_path), keep=0)

@@ -392,3 +392,115 @@ def test_torn_tail_at_first_file_head_refuses_repair(tmp_path):
     with pytest.raises(TornTailError):
         WAL.open_at_index(d, 0).read_all(repair=True)
     assert os.path.getsize(f0) == size  # untouched, not husked
+
+
+# -- segment GC (PR 6): bounded disk + crash ordering ------------------------
+
+
+def _segmented_wal(tmp_path, n_cuts=3, per_seg=4):
+    """A WAL with n_cuts+1 segments, per_seg entries each; returns
+    (dir, last_index)."""
+    d = str(tmp_path / "wal")
+    w = WAL.create(d, b"meta")
+    idx = -1
+    for _ in range(n_cuts + 1):
+        ents = [ent(idx + j + 1, data=b"x" * 16)
+                for j in range(per_seg)]
+        idx += per_seg
+        w.save(HardState(term=1, vote=0, commit=idx), ents)
+        w.cut()
+    w.close()
+    return d, idx
+
+
+def test_gc_removes_only_wholly_behind_segments(tmp_path):
+    from etcd_tpu.obs.metrics import registry as obs
+
+    d, last = _segmented_wal(tmp_path, n_cuts=3, per_seg=4)
+    w = WAL.open_at_index(d, 0)
+    w.read_all()
+    names = sorted(os.listdir(d))
+    assert len(names) == 5  # 4 entry segments + trailing empty cut
+    # GC at an index inside segment 2: segments 0 and 1 go, the
+    # segment CONTAINING the index stays (restart replays from it)
+    _, seg2_start = parse_wal_name(names[2])
+    before = obs.counter("etcd_wal_segments_gc_total").get()
+    assert w.gc(seg2_start + 1) == 2
+    assert obs.counter("etcd_wal_segments_gc_total").get() \
+        == before + 2
+    left = sorted(os.listdir(d))
+    assert left == names[2:]
+    assert is_valid_seq(left)
+    # idempotent: nothing further behind
+    assert w.gc(seg2_start + 1) == 0
+    w.close()
+    # the chain still replays from the GC boundary
+    w2 = WAL.open_at_index(d, seg2_start)
+    _, _, ents = w2.read_all()
+    assert [e.index for e in ents] == list(range(seg2_start, last + 1))
+    w2.close()
+
+
+def test_gc_below_chain_is_noop(tmp_path):
+    d, _ = _segmented_wal(tmp_path, n_cuts=1)
+    w = WAL.open_at_index(d, 0)
+    w.read_all()
+    assert w.gc(0) == 0  # index inside the first segment: keep all
+    w.close()
+
+
+def test_gc_crash_between_snapshot_and_gc_restarts_clean(tmp_path):
+    """Crash ordering case 1: the snapshot landed (durable) but the
+    GC never ran — the OLD chain must still restart cleanly from
+    either boundary."""
+    d, last = _segmented_wal(tmp_path, n_cuts=2, per_seg=4)
+    # no gc at all: open at 0 AND at the would-be snapshot index work
+    for idx in (0, 5):
+        w = WAL.open_at_index(d, idx)
+        _, _, ents = w.read_all()
+        assert ents[-1].index == last
+        w.close()
+
+
+def test_gc_crash_mid_gc_leaves_contiguous_suffix(tmp_path):
+    """Crash ordering case 2: the process died after SOME unlinks.
+    GC removes oldest-first with a dir fsync per unlink, so any
+    surviving subset is a seq-contiguous suffix covering the
+    snapshot index — simulate every possible crash point."""
+    snap_idx = 9  # inside segment 2 (segments hold 1..4, 5..8, 9..12)
+    for crashed_after in (1, 2):
+        d, last = _segmented_wal(tmp_path / f"c{crashed_after}",
+                                 n_cuts=3, per_seg=4)
+        names = sorted(os.listdir(d))
+        # simulate: GC would remove names[0] and names[1] oldest
+        # first; crash after `crashed_after` unlinks
+        for n in names[:crashed_after]:
+            os.remove(os.path.join(d, n))
+        left = sorted(os.listdir(d))
+        assert is_valid_seq(left)
+        w = WAL.open_at_index(d, snap_idx)
+        _, _, ents = w.read_all()
+        assert ents[-1].index == last
+        w.close()
+        # restart-time GC finishes the job
+        w = WAL.open_at_index(d, snap_idx)
+        w.read_all()
+        w.gc(snap_idx)
+        assert len(os.listdir(d)) == len(names) - 2
+        w.close()
+
+
+def test_gc_never_removes_append_segment(tmp_path):
+    """GC at an index far past everything keeps the segment being
+    appended to (search_index clamps to the last segment)."""
+    d = str(tmp_path / "wal")
+    w = WAL.create(d, b"meta")
+    w.save(HardState(term=1, vote=0, commit=1),
+           [ent(0, term=0), ent(1)])
+    assert w.gc(10 ** 6) == 0
+    w.save(HardState(term=1, vote=0, commit=2), [ent(2)])
+    w.close()
+    w2 = WAL.open_at_index(d, 0)
+    _, _, ents = w2.read_all()
+    assert [e.index for e in ents] == [0, 1, 2]
+    w2.close()
