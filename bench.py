@@ -751,6 +751,29 @@ def run_extra_configs(extra: dict, backend: str,
             log(f"dist bench (small window) failed: {e!r}")
         if not rows:
             del extra["dist_cluster"]
+        # read-heavy row (PR 7): the linearizable read path under a
+        # 95/5 offered load — reads ride the zero-WAL lease/
+        # ReadIndex lane while writes replicate concurrently; the
+        # row carries the serve-path split and the ReadIndex
+        # batch-size evidence alongside both rates.  Its OWN key:
+        # dist_cluster rows are keyed by "groups" and carry write-
+        # throughput fields this row doesn't have.
+        try:
+            r = _run_json_subbench(
+                "dist_bench.py",
+                ["--read-mix", "95/5",
+                 str(max(20 * DIST_PROPOSALS, 100_000)), "16",
+                 "512"],
+                key="reads_per_sec", timeout=600)
+            if r is not None:
+                log(f"dist[read-mix 95/5]: {r['reads_per_sec']}/s "
+                    f"reads vs {r['writes_acked_per_sec']}/s acked "
+                    f"writes (ratio {r.get('read_write_ratio')}, "
+                    f"serve paths {r.get('read_serves_by_path')})")
+                extra["dist_read_mix"] = r
+                checkpoint("dist_read_mix", r)
+        except Exception as e:
+            log(f"dist bench (read mix) failed: {e!r}")
 
 
 def _run_json_subbench(script_name: str, argv: list[str], key: str,

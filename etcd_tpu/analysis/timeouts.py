@@ -24,6 +24,16 @@ written down:
   default in the same module, or a non-positive election default —
   the CLI is a config surface too, and its defaults are the most
   widely deployed config of all.
+- ``lease-band`` (PR 7): a leader lease may only vouch for reads
+  while no quorum-heard follower can have fired its election timer,
+  so ``lease_ticks < election − drift`` (drift = ``max(1,
+  election // 10)``, the clock-drift margin) at every surface: a
+  ``DistServer`` call with literal ``lease_ticks`` and a known
+  election, and an argparse ``--*lease*`` default against the
+  ``--*election*`` default in the same module.  A lease at or past
+  the band is a linearizability violation waiting for a partition —
+  a new leader can commit while the stale lease still serves.
+  ``lease_ticks <= 0`` (lease disabled / auto) stays quiet.
 
 Dynamic values stay quiet (the runtime clamp still covers them);
 this checker exists so constants written in code and flag tables
@@ -63,6 +73,15 @@ _ELECTION_CTORS = {
     "MultiRaft": (1, 3, 10),
     "init_groups": (1, 3, 10),
 }
+
+def _lease_drift(election: int) -> int:
+    """The lease band's clock-drift margin in ticks.  This package
+    is stdlib-only, so this is a COPY of the runtime's formula
+    (server/readindex.py:lease_drift_ticks) — pinned equal by
+    tests/test_analysis.py's drift-guard so the static band and the
+    runtime validation can never disagree."""
+    return max(1, election // 10)
+
 
 #: classic tier: (election positional index, heartbeat positional
 #: index) — Raft(id, peers, election, heartbeat),
@@ -125,23 +144,46 @@ class TimeoutBandChecker(Checker):
     def _check_distserver(self, relpath, scope, call,
                           findings) -> None:
         peers = _arg(call, None, "peer_urls")
-        if not isinstance(peers, (ast.List, ast.Tuple)):
-            return
-        m = len(peers.elts)
+        m = (len(peers.elts)
+             if isinstance(peers, (ast.List, ast.Tuple)) else None)
         e_node = _arg(call, None, "election")
         e = _const_int(e_node) if e_node is not None else 10
-        if e is None or m == 0:
+        if e is not None and m:
+            if e < m:
+                findings.append(Finding(
+                    checker=self.name, path=relpath,
+                    line=call.lineno,
+                    rule="election-band", scope=scope,
+                    message=(
+                        f"`DistServer(... peer_urls=<{m} hosts>, "
+                        f"election={e})`: {m} disjoint election "
+                        f"bands cannot fit in [{e}, {2 * e}) — pass "
+                        f"election >= len(peer_urls)"),
+                    detail=f"DistServer:m>{e}"))
+        # lease-band (PR 7): only when lease_ticks is an explicit
+        # literal (the omitted default, election//2, always sits in
+        # band; <= 0 disables the lease).  election must be known
+        # too — the constructor clamps election up to m, so use the
+        # clamped value when the peer list is literal.
+        lease = _const_int(_arg(call, None, "lease_ticks"))
+        if lease is None or lease <= 0 or e is None or not m:
+            # dynamic values stay quiet — the runtime validation
+            # (DistServer.__init__ raises) still covers them
             return
-        if e < m:
+        e_eff = max(e, m)
+        if lease >= e_eff - _lease_drift(e_eff):
             findings.append(Finding(
                 checker=self.name, path=relpath, line=call.lineno,
-                rule="election-band", scope=scope,
+                rule="lease-band", scope=scope,
                 message=(
-                    f"`DistServer(... peer_urls=<{m} hosts>, "
-                    f"election={e})`: {m} disjoint election bands "
-                    f"cannot fit in [{e}, {2 * e}) — pass "
-                    f"election >= len(peer_urls)"),
-                detail=f"DistServer:m>{e}"))
+                    f"`DistServer(... election={e}, "
+                    f"lease_ticks={lease})`: the lease must sit "
+                    f"strictly below election - drift = {e_eff} - "
+                    f"{_lease_drift(e_eff)} ticks, or a stale "
+                    f"lease can serve reads after a new leader "
+                    f"commits (linearizability violation under "
+                    f"partition)"),
+                detail=f"DistServer:lease>={lease}"))
 
     def _check_heartbeat(self, relpath, scope, leaf, call,
                          findings) -> None:
@@ -165,6 +207,7 @@ class TimeoutBandChecker(Checker):
                         findings) -> None:
         election: list[tuple[str, int, ast.Call]] = []
         members: list[tuple[str, int]] = []
+        leases: list[tuple[str, int, ast.Call]] = []
         for node in ast.walk(tree):
             if not (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
@@ -181,6 +224,31 @@ class TimeoutBandChecker(Checker):
                 election.append((flag, default, node))
             elif "members" in flag:
                 members.append((flag, default))
+            elif "lease" in flag:
+                leases.append((flag, default, node))
+        # lease-band on flag tables: a --*lease* default must clear
+        # the --*election* default's band in the same module
+        # (<= 0 = lease disabled/auto, quiet)
+        for lflag, ldefault, lnode in leases:
+            if ldefault <= 0:
+                continue
+            for eflag, edefault, _enode in election:
+                if edefault <= 0:
+                    continue
+                if ldefault >= edefault - _lease_drift(edefault):
+                    findings.append(Finding(
+                        checker=self.name, path=relpath,
+                        line=lnode.lineno, rule="lease-band",
+                        scope=scopes.get(lnode, ""),
+                        message=(
+                            f"`{lflag}` default {ldefault} is not "
+                            f"strictly below `{eflag}` default "
+                            f"{edefault} minus the "
+                            f"{_lease_drift(edefault)}-tick drift "
+                            f"margin — a stale lease could serve "
+                            f"reads after a new leader commits; "
+                            f"lower the lease default"),
+                        detail=f"{lflag}>={ldefault}"))
         for flag, default, node in election:
             scope = scopes.get(node, "")
             if default <= 0:

@@ -85,7 +85,11 @@ class Client:
         return self._do("PUT", key, form=form)
 
     def get(self, key: str, recursive: bool = False, sorted: bool = False,
-            quorum: bool = False):
+            quorum: bool = False, serializable: bool = False):
+        """``quorum`` forces the through-the-log read; default GETs
+        are linearizable on the dist tier (leader lease / batched
+        ReadIndex / follower wait-point — PR 7); ``serializable``
+        opts back into the possibly-stale local-replica read."""
         params = {}
         if recursive:
             params["recursive"] = "true"
@@ -93,6 +97,8 @@ class Client:
             params["sorted"] = "true"
         if quorum:
             params["quorum"] = "true"
+        if serializable:
+            params["serializable"] = "true"
         return self._do("GET", key, params=params)
 
     def delete(self, key: str, recursive: bool = False, dir: bool = False,

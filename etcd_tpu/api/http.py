@@ -127,6 +127,12 @@ def parse_request(method: str, path: str, form: dict[str, list[str]],
         stream=stream,
         wait=wait,
         quorum=get_bool("quorum"),
+        # PR 7 consistency knob: GETs are linearizable by default on
+        # the dist tier (lease/ReadIndex/follower-wait, no WAL);
+        # ?serializable=true opts back into the possibly-stale
+        # local-replica read, ?quorum=true remains the
+        # through-the-log QGET
+        serializable=get_bool("serializable"),
     )
 
     if ttl is not None:

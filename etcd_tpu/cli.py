@@ -124,6 +124,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "mode; must be >= the number of --dist-peers "
                         "hosts so per-slot election bands stay "
                         "disjoint")
+    # lease band (PR 7): the lease-band lint rule guards this
+    # default against --dist-election-ticks (lease < election -
+    # drift), and start_dist re-checks the actual values the same
+    # way DistServer will
+    p.add_argument("--dist-lease-ticks", type=int, default=30,
+                   help="Leader-lease length in ticks for "
+                        "linearizable reads (must be < "
+                        "--dist-election-ticks minus the clock-"
+                        "drift margin; 0 disables the lease — "
+                        "every linearizable read then takes the "
+                        "batched ReadIndex confirmation)")
     p.add_argument("--dist-pipeline-depth", type=int, default=8,
                    help="Max in-flight append frames per peer "
                         "(windowed replication pipeline; 1 = "
@@ -241,6 +252,22 @@ def start_dist(args, explicit: set[str]) -> int:
                   args.dist_election_ticks,
                   2 * args.dist_election_ticks, len(peers))
         return 1
+    if args.dist_lease_ticks > 0:
+        from .server.readindex import lease_drift_ticks
+
+        eff = max(args.dist_election_ticks, len(peers))
+        if args.dist_lease_ticks >= eff - lease_drift_ticks(eff):
+            # the lease-band invariant made loud at the config
+            # surface (the DistServer constructor re-raises the same
+            # rule): a lease at or past election - drift can serve
+            # reads after a new leader commits
+            log.error("--dist-lease-ticks=%d must be strictly below "
+                      "--dist-election-ticks minus the clock-drift "
+                      "margin (%d - %d); pass a smaller lease or 0 "
+                      "to disable lease reads",
+                      args.dist_lease_ticks, eff,
+                      lease_drift_ticks(eff))
+            return 1
     data_dir = args.data_dir or f"{args.name}_dist{args.dist_slot}_data"
     os.makedirs(data_dir, mode=0o700, exist_ok=True)
     g = args.cohosted_groups or 64
@@ -269,7 +296,8 @@ def start_dist(args, explicit: set[str]) -> int:
                        peer_tls=peer_tls if not peer_tls.empty()
                        else None,
                        pipeline_depth=args.dist_pipeline_depth,
-                       coalesce_us=args.dist_coalesce_us)
+                       coalesce_us=args.dist_coalesce_us,
+                       lease_ticks=args.dist_lease_ticks)
     except ValueError as e:
         log.error("%s", e)
         return 1

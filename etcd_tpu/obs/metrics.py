@@ -191,6 +191,29 @@ _DEFS = (
         "WAL segment files deleted behind the durable snapshot "
         "index (delete-after-fsync GC; the bounded-disk invariant)."),
     MetricDef(
+        "etcd_read_index_batch_size", "histogram",
+        "Pending linearizable reads released per confirmation sweep "
+        "(PR 7 batched ReadIndex: one [G] quorum-basis compare "
+        "amortizes the quorum check over every read it releases; "
+        "p50 > 1 under load is the not-per-read-rounds evidence).",
+        buckets=SIZE_BUCKETS, window=2048),
+    MetricDef(
+        "etcd_read_serve_total", "counter",
+        "Linearizable/serializable read serves by path and outcome. "
+        "path: lease (quorum-free clock-bound serve) | read_index "
+        "(batched quorum-confirmed) | follower_wait (leader read "
+        "index + local commit-index wait-point) | serializable "
+        "(explicit opt-out, possibly stale) | quorum (QGET through "
+        "the log, counted at apply) | cohosted (fused single-copy "
+        "tier).  outcome: ok | timeout | not_leader | no_leader | "
+        "stopped | expired (dropped by the server-side expiry "
+        "sweep).", labels=("path", "outcome")),
+    MetricDef(
+        "etcd_read_rtt_seconds", "histogram",
+        "Linearizable read round trip, stamped register -> serve "
+        "(lease serves land in the first buckets; ReadIndex serves "
+        "pay the piggybacked confirmation round).", window=4096),
+    MetricDef(
         "etcd_lint_findings", "gauge",
         "Findings per checker in the last static-analysis run "
         "(baselined findings included; suppressed ones not).",

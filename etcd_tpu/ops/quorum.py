@@ -15,6 +15,8 @@ own term — the Raft safety rule the reference encodes in
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -54,3 +56,33 @@ def maybe_commit_batch(match: jnp.ndarray, nmembers: jnp.ndarray,
     t_at = jnp.take_along_axis(log_terms, slot[:, None], axis=1)[:, 0]
     ok = (mci > committed) & (t_at == term)
     return jnp.where(ok, mci, committed)
+
+
+def quorum_basis(ack_t0: np.ndarray, members: np.ndarray,
+                 nmembers: np.ndarray, slot: int,
+                 now: float) -> np.ndarray:
+    """Read-quorum time basis per group: float64 [G] (PR 7).
+
+    The lease/ReadIndex analog of :func:`commit_index_batch` — the
+    same q-th-largest order statistic over the member axis, applied
+    to TIME instead of match indices.  ``ack_t0`` [M, G] is the SEND
+    time (leader monotonic clock) of the newest matched append/
+    heartbeat ack per peer per lane (distserver's LeaseClock);
+    ``members`` [G, M] the live-membership mask; this host's own slot
+    counts as ``now`` (its copy of the lease evidence is always
+    fresh).  The result is the latest time ``T`` such that a quorum
+    of group g's members have positively acknowledged this host's
+    leadership of lane g via frames SENT at or after ``T`` — every
+    read registered before ``T`` is thereby ReadIndex-confirmed, and
+    a lease is valid while ``T + lease_s > now``.
+
+    Host numpy by design (not a jit op): the inputs are wall-clock
+    floats produced on ack/reader threads, M is tiny (3-5 hosts),
+    and the sweep runs under the server lock between device rounds —
+    a device round trip would cost more than the sort.
+    """
+    v = np.where(members, ack_t0.T, -np.inf)          # [G, M]
+    v[:, slot] = np.where(members[:, slot], now, -np.inf)
+    srt = np.sort(v, axis=1)[:, ::-1]                 # descending
+    q = np.asarray(nmembers) // 2 + 1
+    return np.take_along_axis(srt, (q - 1)[:, None], axis=1)[:, 0]

@@ -61,8 +61,9 @@ from ..snap.stream import (
     StaleSourceError,
 )
 from ..store import Store
+from ..utils.errors import EtcdError
 from ..utils.trace import tracer
-from ..utils.wait import Wait
+from ..utils.wait import Chan, Wait
 from ..wal import WAL, exist as wal_exist
 from ..wire import Entry, GroupEntry, HardState, Snapshot
 from ..wire.distmsg import (
@@ -76,6 +77,14 @@ from ..wire.requests import Info, Request
 from .distpipe import AppendPipeline
 from .multigroup import TICK_INTERVAL, group_of
 from .peerlink import KeepAlivePool, PipeChannel
+from .readindex import (
+    PATH_SERIALIZABLE,
+    LeaseClock,
+    ReadQueue,
+    WaitPoints,
+    lease_drift_ticks,
+    serve_counter,
+)
 from .server import (
     DEFAULT_SNAP_COUNT,
     Response,
@@ -86,6 +95,17 @@ from .server import (
 )
 
 log = logging.getLogger(__name__)
+
+# Peer-tier read endpoints (PR 7 linearizable read path)
+READ_INDEX_PATH = "/mraft/readindex"
+GET_MANY_PATH = "/mraft/get_many"
+
+# read_many result-slot sentinels: identity-compared module objects,
+# never strings — a STORED VALUE equal to any string sentinel would
+# collide with it (the compact fast path writes raw leaf values into
+# the same result list)
+_SERZ = object()     # serializable entry, serve after the linz pass
+_EXPIRED = object()  # pending read dropped by the expiry sweep
 
 # WAL record kinds (GroupEntry.kind)
 K_ENTRY = 0      # a group's log entry
@@ -128,7 +148,8 @@ class DistServer:
                  coalesce_us: int = 2000,
                  coalesce_ents: int = 512,
                  coalesce_bytes: int = 1 << 20,
-                 snap_keep: int | None = None):
+                 snap_keep: int | None = None,
+                 lease_ticks: int | None = None):
         self.slot = slot
         self.g, self.m = g, len(peer_urls)
         # live member slots (< m leaves spare slots for runtime
@@ -255,6 +276,16 @@ class DistServer:
             thread_name_prefix=f"dist{slot}-xchg")
         self._pool = KeepAlivePool(timeout=post_timeout,
                                    ssl_context=self._peer_ssl_cli)
+        # read-index fetches ride their OWN keep-alive pool: the
+        # leader's /mraft/readindex handler may lawfully hold the
+        # request for up to 5s awaiting quorum confirmation
+        # (fresh-leader window), while the shared pool's socket
+        # timeout is post_timeout (1-2s) — over there a slow-but-
+        # answering leader would read as unreachable, fail the read
+        # no_leader, and tear down the pooled socket
+        self._ri_pool = KeepAlivePool(
+            timeout=max(6.0, 3.0 * post_timeout),
+            ssl_context=self._peer_ssl_cli)
 
         # Windowed append pipeline (PR 5): per-peer (epoch, seq)
         # tagged in-flight frames over striped pipelined connections;
@@ -377,6 +408,58 @@ class DistServer:
                                    peer=str(p))
             for p in range(self.m) if p != slot}
 
+        # -- linearizable read path (PR 7) ----------------------------
+        # Lease band: the lease may only vouch for leadership while
+        # NO follower the quorum heard from can have fired its
+        # election timer — lease_ticks must sit strictly below the
+        # election band minus a clock-drift margin (the same
+        # invariant the static lease-band checker enforces at call
+        # sites and flag tables; DistMember clamps election >= m, so
+        # validate against the clamped value).  lease_ticks=0
+        # disables the lease: every linearizable read then takes the
+        # batched-ReadIndex confirmation.
+        eff_election = max(election, self.m)
+        drift = lease_drift_ticks(eff_election)
+        if lease_ticks is None:
+            lease_ticks = eff_election // 2
+        if lease_ticks < 0:
+            raise ValueError(f"lease_ticks={lease_ticks} < 0")
+        if lease_ticks and lease_ticks >= eff_election - drift:
+            raise ValueError(
+                f"lease_ticks={lease_ticks} must be < election - "
+                f"drift margin = {eff_election} - {drift}: a lease "
+                f"that outlives the election band could serve reads "
+                f"after a new leader commits")
+        self._lease_s = lease_ticks * tick_interval
+        self.lease = LeaseClock(g, self.m, slot)
+        self._reads = ReadQueue(g)
+        self._waits = WaitPoints(g)
+        # current-term-commit gate (raft thesis §6.4): a fresh leader
+        # must not serve reads at its (possibly stale) commit index
+        # until an entry of ITS term commits — _read_ok[g] tracks
+        # that off the frontier terms _persist already computes, and
+        # _read_floor[g] is the commit index when it first held
+        # (>= every index an older leader could have committed).
+        self._read_ok = np.zeros(g, bool)
+        self._read_floor = np.zeros(g, np.int64)
+        # host caches the read hot path serves from (a device fetch
+        # per GET would cost more than the read): leadership is
+        # _prev_lead (refreshed each round), hint mirrors the round
+        # loop's fetch, membership refreshes on conf change/install
+        self._hint_np = np.full(g, -1, np.int64)
+        self._read_nudge_t = 0.0
+        self._wait_expire_at = 0.0  # wait-point sweep cadence gate
+        # namespace -> group cache: group_of is a sha1 per call and
+        # the read lane routes tens of thousands of keys/s over a
+        # small working set of first path segments (bounded: cleared
+        # wholesale if an adversarial key stream ever fills it)
+        self._ns_groups: dict[str, int] = {}
+        self._m_ri_batch = _obs.registry.histogram(
+            "etcd_read_index_batch_size")
+        self._m_read_rtt = _obs.registry.histogram(
+            "etcd_read_rtt_seconds")
+        self._read_ctrs: dict[tuple[str, str], object] = {}
+
         self.mr = DistMember(g, self.m, slot, cap,
                              election=election,
                              max_batch_ents=max_batch_ents, seed=slot,
@@ -402,6 +485,17 @@ class DistServer:
         self.mesh = mesh
         if mesh is not None:
             self.mr.shard(mesh)
+        self._refresh_member_cache()
+
+    def _refresh_member_cache(self) -> None:
+        """Host copy of the engine's [G, M] membership (call with
+        self.lock held; init/restart call before the lock exists).
+        The read path's quorum-basis math runs per GET — it must
+        not pay a device fetch for arrays that change only on conf
+        changes and snapshot installs."""
+        st = self.mr.state
+        self._members_np = np.asarray(st.members).astype(bool)
+        self._nmembers_np = np.asarray(st.nmembers).astype(np.int64)
 
     # -- restart ----------------------------------------------------------
 
@@ -546,8 +640,7 @@ class DistServer:
         threading.Thread(target=self._publish, daemon=True).start()
         u = urlparse(self.peer_urls[self.slot])
         handler = _make_peer_handler(self)
-        self._httpd = ThreadingHTTPServer((u.hostname, u.port),
-                                          handler)
+        self._httpd = _PeerHTTPServer((u.hostname, u.port), handler)
         self._httpd.daemon_threads = True
         if self._peer_ssl_srv is not None:
             # handshake deferred to the per-connection worker thread
@@ -616,6 +709,7 @@ class DistServer:
         for chan in list(self._channels.values()):
             chan.close()  # fails in-flight frames; done-guard drops
         self._pool.close()
+        self._ri_pool.close()
         # a deferred snapshot may still hold _snap_mutex mid-save;
         # join it before closing the WAL (its cut/gc would raise on
         # a closed file).  Same wedge rule as the round loop: if it
@@ -675,6 +769,19 @@ class DistServer:
                 terms = self._fr_last[1]
             else:
                 terms = self.mr.commit_terms().astype(np.int32)
+                # current-term-commit gate for the read path: the
+                # lane may serve lease/ReadIndex reads only once its
+                # commit frontier carries an entry of the CURRENT
+                # term (self._ballot[0] is the durable host copy of
+                # term — every term transition persists through
+                # _ballot_record before acting).  The floor pins the
+                # commit index at the moment the gate first opened:
+                # >= anything an earlier leader could have committed.
+                ok = terms >= self._ballot[0]
+                self._read_floor = np.where(
+                    ok & ~self._read_ok, commit.astype(np.int64),
+                    self._read_floor)
+                self._read_ok = ok
             self._fr_last = (commit, terms)
             self.seq += 1
             ents = ents + [Entry(
@@ -700,6 +807,11 @@ class DistServer:
                 and np.array_equal(votes, self._ballot[1])):
             return []
         self._ballot = (terms.copy(), votes.copy())
+        # a term bump re-closes the read gate until an entry of the
+        # new term commits (the fresh-leader stale-commit window)
+        self._read_ok = (self._fr_last[1] >= terms
+                         if self._fr_last is not None
+                         else np.zeros(self.g, bool))
         self.raft_term = max(self.raft_term, int(terms.max()))
         self.seq += 1
         return [Entry(index=self.seq, term=self.raft_term,
@@ -965,8 +1077,14 @@ class DistServer:
                 wc = self.store.watch(r.path, r.recursive, r.stream,
                                       r.since)
                 return Response(watcher=wc)
-            ev = self.store.get(r.path, r.recursive, r.sorted)
-            return Response(event=ev)
+            if r.serializable:
+                # explicit opt-out: the pre-PR-7 local-replica read,
+                # possibly stale under partition — counted so bench
+                # forensics can attribute it
+                self._count_read(PATH_SERIALIZABLE, "ok")
+                ev = self.store.get(r.path, r.recursive, r.sorted)
+                return Response(event=ev)
+            return self._linz_read(r, timeout)
         raise UnknownMethodError(r.method)
 
     def do_many(self, reqs: list[Request],
@@ -1009,6 +1127,389 @@ class DistServer:
             left = (None if deadline is None
                     else max(0.0, deadline - time.monotonic()))
             out[i] = self._await_ack(rid, ch, left)
+        return out
+
+    # -- linearizable read path (PR 7) ------------------------------------
+
+    def _count_read(self, path: str, outcome: str, n: int = 1,
+                    t0: float | None = None) -> None:
+        """Serve accounting: the labeled counter (handle cached — a
+        registry lookup per GET would cost a lock + key build), the
+        store-stats per-path split on successful serves, and the
+        register->serve RTT histogram."""
+        key = (path, outcome)
+        c = self._read_ctrs.get(key)
+        if c is None:
+            c = self._read_ctrs[key] = serve_counter(path, outcome)
+        c.inc(n)
+        if outcome == "ok":
+            self.store.stats.inc_read_path(path, n)
+        if t0 is not None:
+            self._m_read_rtt.observe(time.monotonic() - t0)
+
+    def _group_cached(self, path: str) -> int:
+        """group_of with the namespace cache (read hot path)."""
+        ns = path.strip("/").split("/", 1)[0]
+        gi = self._ns_groups.get(ns)
+        if gi is None:
+            if len(self._ns_groups) >= 65536:
+                self._ns_groups.clear()
+            gi = self._ns_groups[ns] = group_of(path, self.g)
+        return gi
+
+    def _lease_fast_ok(self, gi: int, now: float) -> bool:
+        """One group's lease check (call with self.lock held): the
+        lane is led with a current-term commit applied, and a quorum
+        endorsed this leadership within the lease window — the read
+        serves NOW, no quorum round, no WAL."""
+        if self._lease_s <= 0:
+            return False
+        if not self._read_ok[gi] \
+                or self.applied[gi] < self._read_floor[gi]:
+            return False
+        b = self.lease.basis_one(gi, self._members_np,
+                                 self._nmembers_np, now)
+        return b + self._lease_s > now
+
+    def _read_release(self, now: float | None = None) -> None:
+        """Batched ReadIndex release sweep (call with self.lock
+        held): ONE [G] quorum-basis computation confirms every
+        pending read whose registration a completed quorum round (or
+        a valid lease) now covers.  Rides the ack-absorb and round
+        paths, so confirmation piggybacks on frames that were going
+        out anyway."""
+        if not self._reads.pending:
+            return
+        if now is None:
+            now = time.monotonic()
+        basis = self.lease.basis(self._members_np,
+                                 self._nmembers_np, now)
+        released = self._reads.release(
+            lead=self._prev_lead, read_ok=self._read_ok,
+            applied=self.applied, floor=self._read_floor,
+            basis=basis, lease_until=basis + self._lease_s, now=now)
+        if released:
+            self._m_ri_batch.observe(len(released))
+            for pr, path, rd in released:
+                pr.ch.close((path, rd))
+
+    def _nudge_reads(self, now: float) -> None:
+        """A read registered without lease cover (call with
+        self.lock held): arm one out-of-cadence heartbeat per
+        stripe (see _pump_peer) and poke the round loop so the
+        confirmation round leaves promptly instead of at the next
+        tick boundary.  The poke dedups at 1 ms so a single-read
+        burst during a leaderless window can't flood the queue with
+        wakes (each registered read would otherwise add one)."""
+        if now - self._read_nudge_t > 0.001:
+            self._queue.put(None)  # drain treats None as a bare wake
+        self._read_nudge_t = now
+
+    def _await_read(self, ch: Chan, timeout: float | None,
+                    path_hint: str, t0: float):
+        """Block on a registered read's channel; returns the
+        ``(path, rd)`` confirmation or raises the fail-closed
+        error."""
+        try:
+            x = ch.get(timeout=timeout)
+        except queue.Empty:
+            self._count_read(path_hint, "timeout")
+            raise TimeoutError(
+                "linearizable read timed out (no quorum "
+                "confirmation)") from None
+        if x is _EXPIRED:
+            # the server-side expiry sweep dropped us (pathological
+            # confirmation stall) — its own outcome label, NOT
+            # not_leader: leadership may be fine
+            self._count_read(path_hint, "expired")
+            raise TimeoutError(
+                "linearizable read expired server-side awaiting "
+                "confirmation")
+        if x is None:
+            if self.done.is_set():
+                self._count_read(path_hint, "stopped")
+                raise ServerStoppedError()
+            self._count_read(path_hint, "not_leader")
+            raise TimeoutError(
+                "leadership lost before the read confirmed")
+        return x
+
+    def _linz_read(self, r: Request,
+                   timeout: float | None) -> Response:
+        """Default-consistency GET: linearizable without touching
+        the WAL.  Leader lanes serve under the lease (zero extra
+        messages) or via the batched ReadIndex queue; follower lanes
+        fetch a confirmed index from the leader and park on a local
+        commit-index wait-point.  Every failure path is CLOSED — a
+        read is never served from state a quorum may have
+        overwritten."""
+        t0 = time.monotonic()
+        gi = self._group_cached(r.path)
+        ch = None
+        path = "lease"
+        with self.lock:
+            if self.done.is_set():
+                raise ServerStoppedError()
+            led = bool(self._prev_lead[gi])
+            if led:
+                if not self._lease_fast_ok(gi, t0):
+                    ch = Chan()
+                    self._reads.register(gi, t0,
+                                         int(self.applied[gi]), ch)
+                    self._nudge_reads(t0)
+            else:
+                leader = int(self._hint_np[gi])
+        if not led:
+            return self._follower_read(r, gi, leader, t0, timeout)
+        if ch is not None:
+            path = self._await_read(ch, timeout, "read_index", t0)[0]
+        self._count_read(path, "ok", t0=t0)
+        ev = self.store.get(r.path, r.recursive, r.sorted)
+        return Response(event=ev)
+
+    def _follower_read(self, r: Request, gi: int, leader: int,
+                       t0: float,
+                       timeout: float | None) -> Response:
+        """Follower half: leader-confirmed read index + local apply
+        wait-point, then serve from THIS replica (read traffic never
+        ships the value over the peer tier, only the index)."""
+        if leader < 0 or leader == self.slot:
+            self._count_read("follower_wait", "no_leader")
+            raise TimeoutError(
+                "no leader known for linearizable read")
+        rd = self._fetch_read_index(leader, gi)
+        ch = None
+        with self.lock:
+            if self.done.is_set():
+                raise ServerStoppedError()
+            if self.applied[gi] < rd:
+                ch = Chan()
+                self._waits.register(gi, rd, ch,
+                                     t0=time.monotonic())
+        if ch is not None:
+            try:
+                x = ch.get(timeout=timeout)
+            except queue.Empty:
+                self._count_read("follower_wait", "timeout")
+                raise TimeoutError(
+                    "linearizable read timed out awaiting "
+                    "replication") from None
+            if x is _EXPIRED:
+                self._count_read("follower_wait", "expired")
+                raise TimeoutError(
+                    "linearizable read expired awaiting "
+                    "replication")
+            if x is None:
+                self._count_read("follower_wait", "stopped")
+                raise ServerStoppedError()
+        self._count_read("follower_wait", "ok", t0=t0)
+        ev = self.store.get(r.path, r.recursive, r.sorted)
+        return Response(event=ev)
+
+    def _fetch_read_index(self, leader: int, gi: int) -> int:
+        """POST /mraft/readindex to the group's leader over the
+        DEDICATED read-index keep-alive pool (``_ri_pool`` — its
+        socket timeout clears the leader's lawful 5s confirmation
+        hold, which the shared pool's 1-2s timeout would misread as
+        an unreachable leader); returns the confirmed index or
+        raises (fail closed)."""
+        body = json.dumps({"group": int(gi)}).encode()
+        out = self._ri_pool.post(leader, self.peer_urls[leader],
+                                 READ_INDEX_PATH, body)
+        if out is None or out[0] != 200:
+            self._count_read("follower_wait", "no_leader")
+            raise TimeoutError("read-index fetch failed "
+                               "(leader unreachable)")
+        try:
+            d = json.loads(out[1].decode())
+            if "rd" not in d:
+                self._count_read("follower_wait", "not_leader")
+                raise TimeoutError(
+                    f"read-index refused: {d.get('err')}")
+            return int(d["rd"])
+        except (ValueError, TypeError):
+            self._count_read("follower_wait", "no_leader")
+            raise TimeoutError(
+                "read-index reply unparseable") from None
+
+    def read_index(self, gi: int,
+                   timeout: float | None = None) -> int:
+        """Leader service behind POST /mraft/readindex: an apply
+        index ``rd`` such that any replica serving at local
+        ``applied >= rd`` observes every write acked before this
+        call — the lease answers instantly, otherwise the request
+        joins the batched ReadIndex queue like any local read."""
+        if not (0 <= gi < self.g):
+            raise ValueError(f"group {gi} out of range 0..{self.g}")
+        t0 = time.monotonic()
+        with self.lock:
+            if self.done.is_set():
+                raise ServerStoppedError()
+            if not self._prev_lead[gi]:
+                raise TimeoutError("not leader")
+            if self._lease_fast_ok(gi, t0):
+                return max(int(self.applied[gi]),
+                           int(self._read_floor[gi]))
+            ch = Chan()
+            self._reads.register(gi, t0, int(self.applied[gi]), ch,
+                                 kind="rd")
+            self._nudge_reads(t0)
+        return int(self._await_read(ch, timeout, "read_index",
+                                    t0)[1])
+
+    def _serve_read(self, path: str, r: Request | None):
+        """One local store serve; EtcdError (e.g. key-not-found) is
+        a per-entry result, not a batch failure.  Path-string
+        entries (the compact get_many form) come back as the raw
+        leaf VALUE via the store's Event-free fast lane — at the
+        batch lane's read rates the Event allocation is the
+        dominant per-read cost."""
+        try:
+            if r is None:
+                return self.store.get_value(path)
+            return Response(event=self.store.get(
+                path, r.recursive, r.sorted))
+        except EtcdError as e:
+            return e
+
+    def read_many(self, reqs: list,
+                  timeout: float | None = None) -> list:
+        """Batched read path (the GET analog of do_many, behind
+        POST /mraft/get_many).  Entries are plain path strings (the
+        compact wire form — a linearizable read's cost should be
+        its key, not a protobuf decode) or full GET Requests.
+
+        The hot shape is one lock take for the whole batch: lanes
+        whose lease vouches serve via a per-group cached lease
+        check — no per-read channel, no queue — and the rest
+        register and ride ONE release sweep, so a whole batch
+        confirms against one [G] basis compare (the amortization
+        etcd_read_index_batch_size records).  Follower lanes share
+        one read-index fetch per group.  Returns a list aligned
+        with ``reqs``: Response or Exception per entry."""
+        out: list = [None] * len(reqs)
+        t0 = time.monotonic()
+        linz: list[tuple[int, str, Request | None]] = []
+        for i, r in enumerate(reqs):
+            if isinstance(r, str):
+                linz.append((i, r, None))
+            elif r.method != "GET" or r.wait or r.quorum:
+                # quorum (through-the-log) reads and non-reads take
+                # their own paths; the batch endpoint is the
+                # zero-WAL lane
+                out[i] = UnknownMethodError(
+                    f"get_many accepts plain GETs, not "
+                    f"{r.method}{'?quorum' if r.quorum else ''}")
+            elif r.serializable:
+                out[i] = _SERZ
+            else:
+                linz.append((i, r.path, r))
+        fast: list[tuple[int, str, Request | None]] = []
+        chans: list[tuple[int, str, Request | None, Chan]] = []
+        followers: dict[int,
+                        list[tuple[int, str, Request | None]]] = {}
+        if linz:
+            with self.lock:
+                if self.done.is_set():
+                    raise ServerStoppedError()
+                now = time.monotonic()
+                lease_cache: dict[int, bool] = {}
+                for i, path, r in linz:
+                    gi = self._group_cached(path)
+                    ok = lease_cache.get(gi)
+                    if ok is None:
+                        ok = bool(self._prev_lead[gi]) \
+                            and self._lease_fast_ok(gi, now)
+                        lease_cache[gi] = ok
+                    if ok:
+                        fast.append((i, path, r))
+                    elif self._prev_lead[gi]:
+                        ch = Chan()
+                        self._reads.register(
+                            gi, t0, int(self.applied[gi]), ch)
+                        chans.append((i, path, r, ch))
+                    else:
+                        followers.setdefault(gi, []).append(
+                            (i, path, r))
+                if fast:
+                    # the batch IS a confirmation sweep: one lease
+                    # check per group released this many reads
+                    self._m_ri_batch.observe(len(fast))
+                if chans:
+                    self._read_release(now)
+                    if self._reads.pending:
+                        self._nudge_reads(now)
+        if fast:
+            self._count_read("lease", "ok", n=len(fast))
+            # batch-granular RTT sample: every read in the batch
+            # shared this register->serve window
+            self._m_read_rtt.observe(time.monotonic() - t0)
+            plain = [(i, path) for i, path, r in fast if r is None]
+            if plain:
+                # one world-lock take + one stats update for the
+                # whole compact batch
+                for (i, _p), v in zip(plain, self.store.get_values(
+                        [p for _i, p in plain])):
+                    out[i] = v
+            for i, path, r in fast:
+                if r is not None:
+                    out[i] = self._serve_read(path, r)
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        served: dict[str, int] = {}
+        for i, path, r, ch in chans:
+            left = (None if deadline is None
+                    else max(0.0, deadline - time.monotonic()))
+            try:
+                p = self._await_read(ch, left, "read_index", t0)[0]
+            except (TimeoutError, ServerStoppedError) as e:
+                out[i] = e
+                continue
+            served[p] = served.get(p, 0) + 1
+            out[i] = self._serve_read(path, r)
+        for p, n in served.items():
+            self._count_read(p, "ok", n=n)
+        if served:
+            self._m_read_rtt.observe(time.monotonic() - t0)
+        for i, r in ((i, r) for i, r in enumerate(reqs)
+                     if out[i] is _SERZ):
+            self._count_read(PATH_SERIALIZABLE, "ok")
+            out[i] = self._serve_read(r.path, r)
+        def _one_follower_group(gi: int, items) -> None:
+            left = (None if deadline is None
+                    else max(0.0, deadline - time.monotonic()))
+            i0, path0, r0 = items[0]
+            try:
+                out[i0] = self._follower_read(
+                    r0 if r0 is not None
+                    else Request(method="GET", id=1, path=path0),
+                    gi, int(self._hint_np[gi]), t0, left)
+                # the confirmed wait already covers the rest of the
+                # group's batch: serve them straight off the replica
+                if len(items) > 1:
+                    self._count_read("follower_wait", "ok",
+                                     n=len(items) - 1)
+                    for i, path, r in items[1:]:
+                        out[i] = self._serve_read(path, r)
+            except (TimeoutError, ServerStoppedError) as e:
+                for i, _path, _r in items:
+                    out[i] = e
+
+        if len(followers) == 1:
+            gi, items = next(iter(followers.items()))
+            _one_follower_group(gi, items)
+        elif followers:
+            # groups are independent (one index fetch + wait each):
+            # run them concurrently so batch latency is the SLOWEST
+            # group's confirmation, not the sum over groups — each
+            # group writes disjoint out[] slots
+            ths = [threading.Thread(target=_one_follower_group,
+                                    args=(gi, items))
+                   for gi, items in followers.items()]
+            for th in ths:
+                th.start()
+            for th in ths:
+                th.join()
         return out
 
     def _group_of_request(self, r: Request) -> int:
@@ -1159,6 +1660,19 @@ class DistServer:
                     name=f"dist{self.slot}-pull", daemon=True)
                 self._pull_thread.start()
             self._leader_round(batch)
+            # follower wait-point expiry lives HERE, not in
+            # _leader_round: a pure follower's round returns early
+            # there, yet IT is the host that parks wait-points.
+            # Coarse cadence — the sweep is an O(pending) scan.
+            if self._waits.pending \
+                    and time.monotonic() >= self._wait_expire_at:
+                self._wait_expire_at = time.monotonic() + 10.0
+                with self.lock:
+                    expired_waits = self._waits.expire(
+                        time.monotonic(),
+                        max(35.0, 8.0 * self.post_timeout))
+                for ch in expired_waits:
+                    ch.close(_EXPIRED)
             with self.lock:
                 # apply paths raise the flag under the lock; clear it
                 # under the lock too so a set landing between the read
@@ -1195,8 +1709,14 @@ class DistServer:
         with self.lock:
             assigned = list(self._assigned.values())
             self._assigned.clear()
+            pending_reads = self._reads.fail_all()
+            pending_waits = self._waits.fail_all()
         for p in assigned:
             self.w.trigger(p.id, None)
+        for pr in pending_reads:
+            pr.ch.close(None)
+        for ch in pending_waits:
+            ch.close(None)
 
     def _drain(self, timeout: float) -> list[_Pending]:
         """Adaptive-cadence coalescing drain: after the first
@@ -1283,6 +1803,13 @@ class DistServer:
                 self._ack_clock = {
                     k: v for k, v in self._ack_clock.items()
                     if not lost_lead[k[0]]}
+            if lost_lead.any() and self._reads.pending:
+                # reads pending on deposed lanes can never be
+                # confirmed by us — fail them closed (the client
+                # retries against the new leader; serving would be
+                # the stale read this subsystem exists to prevent)
+                for pr in self._reads.fail_lanes(lost_lead):
+                    pr.ch.close(None)
             if won.any():
                 now_w = time.time()
                 terms = mr.terms()
@@ -1302,6 +1829,7 @@ class DistServer:
 
             lead_any = bool(lead.any())
             hint = mr.leader_hint()
+            self._hint_np = hint  # host cache for the read path
             known = hint[hint >= 0]
             self.server_stats.set_state(
                 STATE_LEADER if lead_any else STATE_FOLLOWER,
@@ -1391,6 +1919,15 @@ class DistServer:
                 self._persist([])
             with tracer.span("dist.apply"):
                 self._apply_committed(self._assigned)
+            # read maintenance: drop waiters whose callers timed out
+            # (the age bound sits ABOVE the 30s get_many handler
+            # budget so an in-budget caller is never force-failed
+            # early), then sweep (applied/floor moved this round)
+            now_r = time.monotonic()
+            for pr in self._reads.expire(
+                    now_r, max(35.0, 8.0 * self.post_timeout)):
+                pr.ch.close(_EXPIRED)
+            self._read_release(now_r)
 
     # -- the append pipeline (PR 5) ---------------------------------------
 
@@ -1478,8 +2015,14 @@ class DistServer:
                         commit = np.asarray(b.commit)
                     adv = bool(((commit > self._sent_commit[peer])
                                 & mask).any())
-                    due = (now - self.pipe.last_send(peer, stripe)
-                           >= self._hb_interval)
+                    last = self.pipe.last_send(peer, stripe)
+                    # a pending ReadIndex confirmation nudges ONE
+                    # out-of-cadence heartbeat per stripe: its ack
+                    # is the quorum round the queued reads piggyback
+                    # on (last >= nudge time means this stripe
+                    # already sent its post-registration frame)
+                    due = (now - last >= self._hb_interval
+                           or last < self._read_nudge_t)
                     if not (adv or due):
                         break
                 meta = self.pipe.register(
@@ -1590,6 +2133,16 @@ class DistServer:
             mr.handle_append_resp(resp)
         active = np.asarray(resp.active)
         ok = np.asarray(resp.ok)
+        # lease / ReadIndex evidence (PR 7): count only active & OK
+        # lanes — both are subsets of the follower's ``cur`` (it
+        # held OUR term and reset its election timer when this frame
+        # arrived).  ``active`` alone is NOT cur-only: the follower
+        # folds need_snap lanes into it even at a HIGHER term so the
+        # step-down can propagate (distmember.handle_append), and a
+        # deposing ack must never extend a lease.  The cost is that
+        # cur-but-rejected lanes (probe catch-up) don't renew —
+        # conservative: the quorum's healthy members carry the basis.
+        self.lease.note_ack(peer, meta.t0, active & ok)
         if (active & ~ok).any():
             # follower found a gap (dropped or out-of-order frame):
             # next_ was repaired from its commit hint; collapse to
@@ -1603,6 +2156,10 @@ class DistServer:
         with tracer.span("dist.apply"):
             self._apply_committed(self._assigned)
         self._pump_peer(peer)
+        # the ack may have advanced the quorum basis past pending
+        # reads' registration times — the batched release sweep
+        # rides the ack path, not a timer
+        self._read_release()
 
     def _campaign(self, mask: np.ndarray) -> None:
         """Batched election round-trip for the fired lanes."""
@@ -1782,6 +2339,11 @@ class DistServer:
         self._m_apply_n.observe(n_apply)
         self._m_apply_s.observe(time.perf_counter() - t_apply)
         mr.mark_applied(self.applied)
+        # follower linearizable reads park on commit-index
+        # wait-points; the advanced apply frontier releases them
+        if self._waits.pending:
+            for ch in self._waits.release(self.applied):
+                ch.close(True)
         # lane-fill compaction, decoupled from the snap_count-gated
         # snapshot: periodic SYNC entries alone would fill a group's
         # fixed-cap log window on an idle cluster long before 10k
@@ -2094,6 +2656,13 @@ class DistServer:
                     self.raft_term = max(self.raft_term,
                                          int(terms.max()))
                     self._persist([])
+                    # the installed frontier may cover parked
+                    # follower reads, and the snapshot's membership
+                    # feeds the read path's quorum math
+                    self._refresh_member_cache()
+                    if self._waits.pending:
+                        for ch in self._waits.release(self.applied):
+                            ch.close(True)
                     self._pull_backoff = 0.0
                     self._pull_not_before = 0.0
                     log.info("dist[%d]: installed streamed snapshot "
@@ -2158,6 +2727,8 @@ class DistServer:
         mask[gi] = True
         self.mr.apply_conf_change(bool(d["add"]), int(d["slot"]),
                                   mask=mask)
+        # the read path's quorum-basis math keys off membership
+        self._refresh_member_cache()
 
     def members_of(self, gi: int) -> np.ndarray:
         """[M] live-membership mask of group ``gi``."""
@@ -2173,6 +2744,16 @@ class DistServer:
 
 
 # -- peer HTTP plumbing -----------------------------------------------------
+
+
+class _PeerHTTPServer(ThreadingHTTPServer):
+    """Peer/batch listener.  The stdlib default listen backlog of 5
+    drops SYNs (= connection resets) the moment a read-heavy client
+    pool opens its connections together — the PR 7 get_many lane
+    serves dozens of concurrent client connections, not just the
+    two peer hosts."""
+
+    request_queue_size = 128
 
 
 def pack_requests(reqs: list[Request]) -> bytes:
@@ -2273,6 +2854,73 @@ def _make_peer_handler(server: DistServer):
                                     "message": str(x)}
                         self._reply(200, json.dumps(
                             {"n": len(res), "errs": errs}).encode())
+                    except Exception as e:
+                        self._reply(400, json.dumps(
+                            {"ok": False,
+                             "message": str(e)}).encode())
+                elif self.path == READ_INDEX_PATH:
+                    # PR 7 follower reads: the leader's confirmed
+                    # read index for one group (lease answers
+                    # instantly; otherwise the request waits in the
+                    # batched ReadIndex queue)
+                    try:
+                        d = json.loads(self._body() or b"{}")
+                        rd = server.read_index(int(d.get("group",
+                                                         -1)),
+                                               timeout=5.0)
+                        self._reply(200, json.dumps(
+                            {"rd": rd}).encode())
+                    except ServerStoppedError:
+                        self._reply(503, b"")
+                    except (TimeoutError, ValueError) as e:
+                        # 200 with an err body: "not leader" is an
+                        # answer, not a transport failure — the
+                        # keep-alive pool must not tear the socket
+                        self._reply(200, json.dumps(
+                            {"err": str(e)}).encode())
+                elif self.path == GET_MANY_PATH:
+                    # PR 7 batched zero-WAL read lane (the GET
+                    # analog of propose_many): values ride back so
+                    # read-burst drivers (bench, chaos linz gate)
+                    # can check what they observed.  Body is either
+                    # a JSON array of path strings (the compact
+                    # form — a read's wire cost is its key) or a
+                    # packed Request batch (flagged reads).
+                    try:
+                        body = self._body()
+                        if body[:1] == b"[":
+                            reqs = json.loads(body)
+                            if not all(isinstance(p, str)
+                                       for p in reqs):
+                                raise ValueError(
+                                    "path list must be strings")
+                        else:
+                            reqs = unpack_requests(body)
+                        res = server.read_many(reqs, timeout=30.0)
+                        vals: list = []
+                        errs = {}
+                        for i, x in enumerate(res):
+                            if isinstance(x, Response):
+                                ev = x.event
+                                vals.append(
+                                    ev.node.value if ev is not None
+                                    and ev.node is not None
+                                    else None)
+                            elif isinstance(x, Exception):
+                                vals.append(None)
+                                errs[str(i)] = {
+                                    "errorCode": getattr(
+                                        x, "error_code", 300),
+                                    "message": str(x)}
+                            else:
+                                # compact path-string entry: the raw
+                                # leaf value (None for a directory)
+                                vals.append(x)
+                        self._reply(200, json.dumps(
+                            {"n": len(res), "vals": vals,
+                             "errs": errs}).encode())
+                    except ServerStoppedError:
+                        self._reply(503, b"")
                     except Exception as e:
                         self._reply(400, json.dumps(
                             {"ok": False,

@@ -73,6 +73,14 @@ _M_APPLY_S = _obs.registry.histogram("etcd_apply_seconds")
 _M_APPLY_N = _obs.registry.histogram("etcd_apply_batch_entries")
 _M_CAMPAIGNS = _obs.registry.counter("etcd_election_campaigns_total")
 _M_WINS = _obs.registry.counter("etcd_election_wins_total")
+# read serve paths (PR 7): the co-hosted tier is single-copy — every
+# member shares ONE store and writes ack only after apply, so a local
+# read is linearizable by construction ("cohosted"); the serializable
+# label marks the explicit opt-out for parity with the dist tier
+_M_READ_COHOSTED = _obs.registry.counter(
+    "etcd_read_serve_total", path="cohosted", outcome="ok")
+_M_READ_SERIALIZABLE = _obs.registry.counter(
+    "etcd_read_serve_total", path="serializable", outcome="ok")
 
 
 def group_of(path: str, g: int) -> int:
@@ -432,6 +440,12 @@ class MultiGroupServer:
                 wc = self.store.watch(r.path, r.recursive, r.stream,
                                       r.since)
                 return Response(watcher=wc)
+            if r.serializable:
+                _M_READ_SERIALIZABLE.inc()
+                self.store.stats.inc_read_path("serializable")
+            else:
+                _M_READ_COHOSTED.inc()
+                self.store.stats.inc_read_path("cohosted")
             ev = self.store.get(r.path, r.recursive, r.sorted)
             return Response(event=ev)
         from .server import UnknownMethodError

@@ -47,6 +47,13 @@ from .sender import new_sender
 
 log = logging.getLogger(__name__)
 
+from ..obs import metrics as _obs  # noqa: E402  (stdlib-only module)
+
+# classic-tier read accounting (PR 7): plain GETs here are
+# local-replica (serializable) serves — see the do() comment
+_M_READ_SERIALIZABLE = _obs.registry.counter(
+    "etcd_read_serve_total", path="serializable", outcome="ok")
+
 DEFAULT_SYNC_TIMEOUT = 1.0
 DEFAULT_SNAP_COUNT = 10000  # reference server.go:29
 DEFAULT_PUBLISH_RETRY_INTERVAL = 5.0
@@ -113,6 +120,10 @@ def apply_request_to_store(store: Store, r: Request) -> Response:
                 r.path, r.prev_value, r.prev_index))
         return f(lambda: store.delete(r.path, r.dir, r.recursive))
     if r.method == "QGET":
+        # through-the-log quorum read: counted at apply — every
+        # replica applies the entry, so per-host stats attribute the
+        # replication cost, not just the origin's serve (PR 7 split)
+        store.stats.inc_read_path("quorum")
         return f(lambda: store.get(r.path, r.recursive, r.sorted))
     if r.method == "SYNC":
         store.delete_expired_keys(r.time / 1e9)
@@ -337,6 +348,14 @@ class EtcdServer:
                 wc = self.store.watch(r.path, r.recursive, r.stream,
                                       r.since)
                 return Response(watcher=wc)
+            # the classic tier keeps reference read semantics: a
+            # plain GET serves the local replica, which on a
+            # follower is a SERIALIZABLE read — counted as such so
+            # the per-path split stays honest (linearizable reads
+            # on this tier go through ?quorum=true; the zero-WAL
+            # lease/ReadIndex machinery lives on the dist tier)
+            _M_READ_SERIALIZABLE.inc()
+            self.store.stats.inc_read_path("serializable")
             ev = self.store.get(r.path, r.recursive, r.sorted)
             return Response(event=ev)
         raise UnknownMethodError(r.method)

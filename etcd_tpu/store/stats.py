@@ -40,23 +40,41 @@ _FIELDS = {
 }
 
 
+# Read-serve paths (PR 7): the GET counters above conflate every
+# read; the serve-path split lets bench forensics attribute read
+# throughput to the lane that actually carried it.  Labels match
+# server/readindex.py's PATH_* constants.
+READ_PATHS = ("lease", "read_index", "follower_wait", "serializable",
+              "quorum", "cohosted")
+
+
 class Stats:
     def __init__(self):
         self._lock = threading.Lock()
         for name in _FIELDS.values():
             setattr(self, name, 0)
         self.watchers = 0
+        self.reads_by_path = {p: 0 for p in READ_PATHS}
 
-    def inc(self, field: int) -> None:
+    def inc(self, field: int, n: int = 1) -> None:
         name = _FIELDS[field]
         with self._lock:
-            setattr(self, name, getattr(self, name) + 1)
+            setattr(self, name, getattr(self, name) + n)
+
+    def inc_read_path(self, path: str, n: int = 1) -> None:
+        """Count a served read against its serve path (PR 7 split:
+        lease / read_index / follower_wait / serializable / quorum /
+        cohosted).  Unknown paths raise — a typo'd path would
+        silently vanish from the bench forensics otherwise."""
+        with self._lock:
+            self.reads_by_path[path] = self.reads_by_path[path] + n
 
     def clone(self) -> "Stats":
         c = Stats()
         for name in _FIELDS.values():
             setattr(c, name, getattr(self, name))
         c.watchers = self.watchers
+        c.reads_by_path = dict(self.reads_by_path)
         return c
 
     def total_reads(self) -> int:
@@ -89,6 +107,9 @@ class Stats:
             "compareAndDeleteFail": self.compare_and_delete_fail,
             "expireCount": self.expire_count,
             "watchers": self.watchers,
+            # additive key (not in the reference struct): per-path
+            # read attribution for the PR 7 linearizable read path
+            "readsByPath": dict(self.reads_by_path),
         }
 
     def to_json(self) -> bytes:
@@ -113,4 +134,5 @@ class Stats:
         s.compare_and_delete_fail = d.get("compareAndDeleteFail", 0)
         s.expire_count = d.get("expireCount", 0)
         s.watchers = d.get("watchers", 0)
+        s.reads_by_path.update(d.get("readsByPath", {}))
         return s

@@ -137,6 +137,49 @@ class Store:
             self.stats.inc(GET_SUCCESS)
             return e
 
+    def get_value(self, node_path: str) -> str | None:
+        """Leaf-value fast lane for the batched read path (PR 7).
+
+        ``get()`` allocates an Event + extern-node tree per call; at
+        the tens-of-thousands-of-reads/s the zero-WAL lane serves,
+        that allocation dominates the actual tree walk.  Same
+        key-not-found EtcdError as get(); a directory yields None
+        (the batched lane reads leaves — callers needing listings
+        use the full form)."""
+        with self.world_lock:
+            node_path = clean_path(node_path)
+            try:
+                n = self._internal_get(node_path)
+            except EtcdError:
+                self.stats.inc(GET_FAIL)
+                raise
+            self.stats.inc(GET_SUCCESS)
+            return None if n.is_dir() else n.value
+
+    def get_values(self, paths: list[str]) -> list:
+        """Batched leaf-value reads: ONE world-lock take and one
+        stats update for the whole batch (the get_many lane serves
+        hundreds of keys per call; a lock cycle per key is pure
+        overhead there).  Per-path results: the value string, None
+        for a directory, or the key-not-found EtcdError."""
+        out: list = []
+        ok = fail = 0
+        with self.world_lock:
+            for p in paths:
+                try:
+                    n = self._internal_get(clean_path(p))
+                except EtcdError as e:
+                    fail += 1
+                    out.append(e)
+                    continue
+                ok += 1
+                out.append(None if n.is_dir() else n.value)
+        if ok:
+            self.stats.inc(GET_SUCCESS, ok)
+        if fail:
+            self.stats.inc(GET_FAIL, fail)
+        return out
+
     # -- mutations ---------------------------------------------------------
 
     def create(self, node_path: str, dir: bool, value: str, unique: bool,

@@ -54,6 +54,12 @@ class Request:
     quorum: bool = False
     time: int = 0  # unix nanos
     stream: bool = False
+    # LOCAL-ONLY (never marshaled): reads don't enter the log, so
+    # the serializable opt-out (PR 7 consistency knob) stays a
+    # process-local routing hint — adding it to the wire form would
+    # perturb every persisted entry's bytes for a field no replica
+    # ever needs.
+    serializable: bool = False
 
     def marshal(self) -> bytes:
         buf = bytearray()

@@ -31,6 +31,14 @@ Gates: the rejoining victim catches up via STREAMED snapshot install
 corrupt chunk is rejected+refetched (never installed), zero acked
 writes are lost, and the survivors' WAL segment / snapshot counts
 stay at their fixed bounds.
+
+Linearizability variant (PR 7): ``--linz [CYCLES]`` kills the
+LEADER mid-read-burst, CYCLES times.  Writer-reader clients assert
+that no read (linearizable default, any host) ever observes a value
+older than that client's own preceding acked write — the lease must
+expire before a new leader can serve — and the closing gate
+requires etcd_read_index_batch_size p50 > 1 (batched ReadIndex, not
+per-read quorum rounds) with zero stale reads.
 """
 import json
 import os
@@ -79,6 +87,12 @@ def start(slot, extra=()):
          # which would make the 4s/5.5s gates unsatisfiable by
          # construction
          "--dist-election-ticks", "10",
+         # lease band rides the pinned election: 5 < 10 - 1 (the
+         # default 30 would be refused against 10-tick elections).
+         # 5 ticks x 0.1s = 0.5s lease — short enough that each
+         # leader kill opens a real ReadIndex window before the new
+         # leader's first confirmed round
+         "--dist-lease-ticks", "5",
          "--listen-client-urls", CLIENT[slot],
          "--advertise-client-urls", CLIENT[slot]],
         env=env, cwd=REPO,
@@ -95,9 +109,15 @@ def put(base, key, val, timeout=20):
         return json.loads(r.read())
 
 
-def get(base, key, timeout=10):
-    with urllib.request.urlopen(f"{base}/v2/keys{key}",
-                                timeout=timeout) as r:
+def get(base, key, timeout=10, serializable=False):
+    """Client GET.  Default = the PR-7 linearizable path (what real
+    clients see); ``serializable=True`` = the local-replica read the
+    drill's replica-equality and lost-write sweeps need (comparing
+    what each REPLICA holds, not what the cluster serves)."""
+    url = f"{base}/v2/keys{key}"
+    if serializable:
+        url += "?serializable=true"
+    with urllib.request.urlopen(url, timeout=timeout) as r:
         return json.loads(r.read())
 
 
@@ -161,6 +181,21 @@ def obs_counter(snap, family, **labels):
         if all(s["labels"].get(k) == v for k, v in labels.items()):
             total += s["value"]
     return total
+
+
+def fetch_leaders(slots, timeout=5):
+    """GET /mraft/leaders from each slot: the server-side
+    leadership-transition trace (election wall time + first
+    post-election apply per group)."""
+    out = {}
+    for s in slots:
+        try:
+            with urllib.request.urlopen(PEERS[s] + "/mraft/leaders",
+                                        timeout=timeout) as r:
+                out[s] = json.loads(r.read())
+        except Exception:
+            pass
+    return out
 
 
 def disk_counts(slot):
@@ -264,7 +299,8 @@ def deep_lag_drill(lag_writes: int) -> None:
             out = {}
             for k in issued:
                 try:
-                    out[k] = get(base, k, timeout=5)["node"]["value"]
+                    out[k] = get(base, k, timeout=5,
+                                 serializable=True)["node"]["value"]
                 except urllib.error.HTTPError:
                     out[k] = None
             return out
@@ -331,7 +367,8 @@ def deep_lag_drill(lag_writes: int) -> None:
         lost = []
         for k, vals in issued.items():
             try:
-                got = get(CLIENT[victim], k)["node"]["value"]
+                got = get(CLIENT[victim], k,
+                          serializable=True)["node"]["value"]
             except urllib.error.HTTPError:
                 continue  # never committed
             if got not in vals:
@@ -349,8 +386,200 @@ def deep_lag_drill(lag_writes: int) -> None:
                 pass
 
 
+def linz_drill(cycles: int) -> None:
+    """Linearizability gate (PR 7): kill the leader mid-read-burst.
+
+    Client model: writer-reader threads each own their keys and
+    alternate PUT (via the v2 client API) with an immediately
+    following linearizable GET — no client may EVER observe a value
+    older than its own preceding acked write, across leader kills
+    and heals.  A failed read is fine (fail closed — counted as
+    rejected); a stale read is the violation this subsystem exists
+    to prevent (the lease must expire before a new leader can
+    serve).  A burst thread drives batched get_many reads the whole
+    time so the post-kill ReadIndex window sees real batches — the
+    closing gate asserts etcd_read_index_batch_size p50 > 1 (quorum
+    confirmation amortized across reads, not per-read rounds).
+    """
+    global procs
+    from etcd_tpu.obs.metrics import (
+        merge_histograms,
+        percentile_from_buckets,
+    )
+
+    shutil.rmtree(BASE, ignore_errors=True)
+    os.makedirs(BASE, exist_ok=True)
+    procs = {i: start(i) for i in range(3)}
+    rng = random.Random(2027)
+    N_CLIENTS = 4
+    stale: list[tuple] = []
+    stats = {"acked": 0, "reads_ok": 0, "reads_rejected": 0,
+             "burst_ok": 0, "burst_err": 0}
+    stats_lock = threading.Lock()
+    stop = threading.Event()
+    alive = [True, True, True]  # writers avoid the killed slot
+
+    def client_loop(t):
+        key = f"{KEYS[t % len(KEYS)]}lz{t}"
+        acked_v = -1
+        acked_set = set()  # which versions actually acked
+        v = 0
+        while not stop.is_set():
+            v += 1
+            targets = [i for i in range(3) if alive[i]]
+            try:
+                put(CLIENT[rng.choice(targets)], key, f"v{v}",
+                    timeout=3)
+                acked_v = v
+                acked_set.add(v)
+                with stats_lock:
+                    stats["acked"] += 1
+            except Exception:
+                pass
+            # the read IMMEDIATELY after: linearizable default, any
+            # host (a follower exercises the wait-point path)
+            try:
+                got = get(CLIENT[rng.choice(targets)], key,
+                          timeout=3)["node"]["value"]
+            except Exception:
+                with stats_lock:
+                    stats["reads_rejected"] += 1
+                continue
+            gv = int(got[1:])
+            if gv < acked_v and gv in acked_set:
+                # a violation ONLY if the observed value was itself
+                # ACKED: a timed-out write is incomplete and may
+                # linearize at any point after its invocation —
+                # committing late (requeue on a re-elected leader)
+                # and overwriting a newer acked value is legal, so
+                # reading it back is too
+                stale.append((t, key, acked_v, gv, time.time()))
+            with stats_lock:
+                stats["reads_ok"] += 1
+
+    def burst_loop():
+        # batched read pressure against random hosts' peer ports:
+        # under a valid lease these observe full-batch sweeps; in
+        # the post-kill window they pile into the ReadIndex queue
+        # and release together on the new leader's first confirmed
+        # round
+        batch = [f"{KEYS[j % len(KEYS)]}lz{j % N_CLIENTS}"
+                 for j in range(64)]
+        body = json.dumps(batch).encode()
+        while not stop.is_set():
+            tgt = rng.randrange(3)
+            req = urllib.request.Request(
+                PEERS[tgt] + "/mraft/get_many", data=body,
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    out = json.loads(r.read())
+                with stats_lock:
+                    stats["burst_ok"] += out["n"] - len(out["errs"])
+                    stats["burst_err"] += len(out["errs"])
+            except Exception:
+                with stats_lock:
+                    stats["burst_err"] += 64
+                time.sleep(0.1)
+
+    def leader_slot():
+        counts = {s: 0 for s in range(3)}
+        for s, d in fetch_leaders([s for s in range(3)
+                                   if alive[s]]).items():
+            counts[s] = sum(1 for x in d["lead"] if x)
+        return max(counts, key=counts.get)
+
+    try:
+        time.sleep(22)
+        deadline = time.time() + 60
+        for key in KEYS:
+            while True:
+                try:
+                    put(CLIENT[0], key, "warmup", timeout=3)
+                    break
+                except Exception:
+                    if time.time() > deadline:
+                        raise RuntimeError("cluster failed to settle")
+                    time.sleep(0.5)
+        print("linz: settled", flush=True)
+        threads = [threading.Thread(target=client_loop, args=(t,),
+                                    daemon=True)
+                   for t in range(N_CLIENTS)]
+        threads.append(threading.Thread(target=burst_loop,
+                                        daemon=True))
+        for th in threads:
+            th.start()
+        for cycle in range(cycles):
+            time.sleep(4.0)  # read burst against a stable leader
+            victim = leader_slot()
+            alive[victim] = False
+            procs[victim].send_signal(signal.SIGKILL)
+            procs[victim].wait()
+            print(f"linz cycle {cycle}: killed leader s{victim} "
+                  f"mid-burst", flush=True)
+            time.sleep(8.0)  # kill window: reads must fail closed,
+            #                  then resume against the new leader
+            procs[victim] = start(victim)
+            time.sleep(10.0)  # rejoin (partition-heal analog: the
+            #                   deposed leader's lease must be long
+            #                   expired before it serves again)
+            alive[victim] = True
+            assert not stale, stale
+        stop.set()
+        for th in threads:
+            th.join(5)
+        assert not stale, stale
+        with stats_lock:
+            print(f"linz: {stats}", flush=True)
+        assert stats["reads_ok"] > 0 and stats["acked"] > 0
+        # ReadIndex batching evidence across the cluster
+        samples = []
+        paths: dict[str, float] = {}
+        for s in range(3):
+            try:
+                snap = fetch_obs(s)
+            except Exception:
+                continue
+            samples += snap.get("etcd_read_index_batch_size",
+                                {}).get("samples", [])
+            for x in snap.get("etcd_read_serve_total",
+                              {}).get("samples", []):
+                if x["labels"].get("outcome") == "ok":
+                    p = x["labels"].get("path", "?")
+                    paths[p] = paths.get(p, 0) + x["value"]
+        merged = merge_histograms(samples)
+        assert merged is not None, "no ReadIndex batch samples"
+        p50 = percentile_from_buckets(merged["bounds"],
+                                      merged["buckets"], 0.5)
+        print(f"linz: read_index_batch p50={p50} "
+              f"(n={merged['count']}), serve paths="
+              f"{ {k: int(v) for k, v in sorted(paths.items())} }",
+              flush=True)
+        assert p50 > 1, \
+            f"ReadIndex batch p50 {p50} <= 1: per-read rounds"
+        print(f"LINZ DRILL CLEAN: {cycles} leader kills, "
+              f"{stats['acked']} acked writes, "
+              f"{stats['reads_ok'] + stats['burst_ok']} reads "
+              f"served, {stats['reads_rejected']} rejected "
+              f"(fail-closed), ZERO stale reads", flush=True)
+    finally:
+        stop.set()
+        for p in procs.values():
+            try:
+                p.kill()
+            except Exception:
+                pass
+
+
+linz_mode = "--linz" in sys.argv
+
 if deep_lag:
     deep_lag_drill(int(_pos[0]) if _pos else 2500)
+    sys.exit(0)
+
+if linz_mode:
+    linz_drill(int(_pos[0]) if _pos else 3)
     sys.exit(0)
 
 
@@ -373,21 +602,6 @@ decomp = []    # per (cycle, group) that re-elected: component delays
 unaffected = []  # client-ack delay for groups that kept their leader
                # (pure probe-resolution baseline)
 decomp_fetch_failures = 0  # cycles whose /mraft/leaders fetch failed
-
-
-def fetch_leaders(slots, timeout=5):
-    """GET /mraft/leaders from each slot: the server-side
-    leadership-transition trace (election wall time + first
-    post-election apply per group)."""
-    out = {}
-    for s in slots:
-        try:
-            with urllib.request.urlopen(PEERS[s] + "/mraft/leaders",
-                                        timeout=timeout) as r:
-                out[s] = json.loads(r.read())
-        except Exception:
-            pass
-    return out
 
 
 def merge_trace(obs, leaders, t_kill):
@@ -580,7 +794,8 @@ try:
         chk = CLIENT[survivors[0]]
         for key, vals in issued.items():
             try:
-                got = get(chk, key)["node"]["value"]
+                got = get(chk, key,
+                          serializable=True)["node"]["value"]
             except urllib.error.HTTPError:
                 continue  # never committed
             if got not in vals:
@@ -605,7 +820,8 @@ try:
             out = {}
             for k in issued:
                 try:
-                    out[k] = get(base, k)["node"]["value"]
+                    out[k] = get(base, k,
+                                 serializable=True)["node"]["value"]
                 except urllib.error.HTTPError:
                     out[k] = None
             return out
@@ -628,7 +844,8 @@ try:
                 vals = {}
                 for k in issued:
                     try:
-                        vals[k] = get(CLIENT[i], k)["node"]["value"]
+                        vals[k] = get(CLIENT[i], k,
+                                      serializable=True)["node"]["value"]
                     except Exception as e:
                         vals[k] = f"<{type(e).__name__}>"
                 print(f"  s{i} keys: {vals}", flush=True)

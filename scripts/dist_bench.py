@@ -25,6 +25,21 @@ Prints ONE JSON line:
   JAX_PLATFORMS=cpu python scripts/dist_bench.py \
       [PROPOSALS] [CONNS] [WINDOW] [GROUPS]
 
+Read-heavy mode (PR 7): ``--read-mix R/W`` (e.g. ``95/5``) measures
+the linearizable read path under a read-dominant offered load — the
+reference's headline workload (shared config + service discovery) is
+overwhelmingly reads.  The client pool splits by the mix into
+free-running reader connections (batched GETs over
+POST /mraft/get_many — the zero-WAL lane: leader-lease serves with
+no quorum round, batched ReadIndex otherwise) and writer connections
+(propose_many), both running the full window so reads/s and
+acked-writes/s come off the same wall clock.  The row carries read
+RTT p50/p99 (client-observed AND the server-side register->serve
+histogram), the serve-path split (lease / read_index /
+follower_wait / serializable), and the ReadIndex batch-size p50;
+``--check`` asserts the PR-7 gate: reads/s >= 50x acked-writes/s
+with lease reads the dominant serve path.
+
 Pipeline-depth sweep (PR 5): ``--sweep`` runs the same workload at
 --dist-pipeline-depth 1/2/4/8/16 (depth=1 is the lockstep-equivalent
 baseline: one frame per peer in flight) on fresh clusters, emits one
@@ -158,6 +173,60 @@ def fetch_pipe_stats(urls, timeout=5):
     }
 
 
+def fetch_read_stats(urls, timeout=5):
+    """Read-path forensics off /mraft/obs: serve counts by
+    path/outcome, the merged register->serve RTT histogram, and the
+    ReadIndex batch-size p50 (amortization evidence: p50 > 1 means
+    sweeps release batches, not per-read rounds)."""
+    paths: dict[str, float] = {}
+    outcomes: dict[str, float] = {}
+    rtt_samples = []
+    batch_samples = []
+    for u in urls:
+        try:
+            with urllib.request.urlopen(u + "/mraft/obs",
+                                        timeout=timeout) as r:
+                snap = json.loads(r.read())
+        except Exception:
+            continue
+        for s in snap.get("etcd_read_serve_total",
+                          {}).get("samples", []):
+            p = s["labels"].get("path", "?")
+            o = s["labels"].get("outcome", "?")
+            if o == "ok":
+                paths[p] = paths.get(p, 0) + s["value"]
+            else:
+                key = f"{p}:{o}"
+                outcomes[key] = outcomes.get(key, 0) + s["value"]
+        rtt_samples += snap.get("etcd_read_rtt_seconds",
+                                {}).get("samples", [])
+        batch_samples += snap.get("etcd_read_index_batch_size",
+                                  {}).get("samples", [])
+    # batch p50 MERGED across hosts (like the RTT below): with
+    # leadership split, one host's big batched sample count must not
+    # mask another host running per-read rounds
+    bm = merge_histograms(batch_samples)
+    out = {
+        "read_serves_by_path": {k: int(v)
+                                for k, v in sorted(paths.items())},
+        "read_fails_by_path_outcome": {
+            k: int(v) for k, v in sorted(outcomes.items())},
+        "read_index_batch_p50":
+            percentile_from_buckets(bm["bounds"], bm["buckets"], 0.5)
+            if bm else 0,
+        "read_index_batch_samples": bm["count"] if bm else 0,
+    }
+    merged = merge_histograms(rtt_samples)
+    if merged is not None:
+        out["read_rtt_server_p50_ms"] = round(
+            percentile_from_buckets(merged["bounds"],
+                                    merged["buckets"], 0.5) * 1e3, 2)
+        out["read_rtt_server_p99_ms"] = round(
+            percentile_from_buckets(merged["bounds"],
+                                    merged["buckets"], 0.99) * 1e3, 2)
+    return out
+
+
 def free_ports(n):
     socks, ports = [], []
     for _ in range(n):
@@ -176,7 +245,7 @@ CAP = int(os.environ.get("DIST_CAP", 1024))  # per-group log window
 SNAP_COUNT = int(os.environ.get("DIST_SNAP_COUNT", 0))
 
 
-def spawn(tmp, slot, urls, depth=8):
+def spawn(tmp, slot, urls, depth=8, extra=()):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
@@ -186,7 +255,7 @@ def spawn(tmp, slot, urls, depth=8):
            "--slot", str(slot), "--peers", ",".join(urls),
            "--groups", str(G), "--cap", str(CAP),
            "--max-batch-ents", "128",
-           "--pipeline-depth", str(depth)]
+           "--pipeline-depth", str(depth), *extra]
     if SNAP_COUNT:
         cmd += ["--snap-count", str(SNAP_COUNT)]
     if slot == 0:
@@ -366,6 +435,209 @@ def run_once(total: int, conns: int, window: int,
             pass  # failed before the row existed
 
 
+def run_read_mix(total: int, conns: int, window: int,
+                 mix: tuple[int, int] = (95, 5),
+                 depth: int = 8,
+                 lease_ticks: int | None = None) -> dict:
+    """Read-heavy row: reader connections free-run batched
+    linearizable GETs while writer connections free-run batched PUTs
+    for the SAME wall window — both rates come off one clock, so the
+    reads/s : acked-writes/s ratio is the real relative capacity of
+    the zero-WAL read lane vs the replicated write path under a
+    ``mix``-proportioned connection split."""
+    import resource
+
+    cpu0 = resource.getrusage(resource.RUSAGE_CHILDREN)
+    ports = free_ports(3)
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    tmp = tempfile.mkdtemp()
+    extra = ([] if lease_ticks is None
+             else ["--lease-ticks", str(lease_ticks)])
+    procs = [spawn(tmp, s, urls, depth=depth, extra=extra)
+             for s in range(3)]
+    r_share = mix[0] / (mix[0] + mix[1])
+    w_conns = max(1, round(conns * (1 - r_share)))
+    r_conns = max(1, conns - w_conns)
+    # the mix governs the OFFERED LOAD: of the conns*window ops in
+    # flight at any instant, the write share is mix[1]/(mix[0]+
+    # mix[1]) — an equal writer window would triple the write share
+    # a 95/5 workload actually offers
+    w_window = max(1, round(conns * window * (1 - r_share)
+                            / w_conns))
+    n_keys = 8 * G
+    keys = [f"/b{i % (8 * G)}/k{i}" for i in range(n_keys)]
+    try:
+        for p in procs:
+            wait_ready(p)
+        host, port = "127.0.0.1", ports[0]
+
+        def post(c, path, body):
+            c.request("POST", path, body=body,
+                      headers={"Content-Type":
+                               "application/octet-stream"})
+            return json.loads(c.getresponse().read().decode())
+
+        # seed every key once so reads always resolve
+        seed = http.client.HTTPConnection(host, port, timeout=180)
+        for lo in range(0, n_keys, 256):
+            out = post(seed, "/mraft/propose_many", pack_requests([
+                Request(method="PUT", id=(7 << 50) + lo + j + 1,
+                        path=k, val="seed")
+                for j, k in enumerate(keys[lo:lo + 256])]))
+            assert not out["errs"], out["errs"]
+        seed.close()
+
+        lat_lock = threading.Lock()
+        r_lats: list[tuple[float, int]] = []
+        reads_done = [0] * r_conns
+        read_errs = [0] * r_conns
+        writes_acked = [0] * w_conns
+        readers_live = threading.Event()
+        readers_live.set()
+        per_reader = [total // r_conns
+                      + (1 if t < total % r_conns else 0)
+                      for t in range(r_conns)]
+
+        def reader(t):
+            c = http.client.HTTPConnection(host, port, timeout=120)
+            sent = 0
+            while sent < per_reader[t]:
+                n = min(window, per_reader[t] - sent)
+                # compact wire form: a JSON array of keys (plain
+                # linearizable GETs) — the read's wire cost is its
+                # key, not a protobuf decode per entry
+                batch = [keys[(sent + j + t * 131) % n_keys]
+                         for j in range(n)]
+                bt0 = time.perf_counter()
+                try:
+                    out = post(c, "/mraft/get_many",
+                               json.dumps(batch).encode())
+                except (OSError, http.client.HTTPException):
+                    # reads are idempotent: reconnect and retry the
+                    # batch (a reset under connection-storm load
+                    # must not kill the conn's whole share)
+                    c.close()
+                    c = http.client.HTTPConnection(host, port,
+                                                   timeout=120)
+                    continue
+                rtt = time.perf_counter() - bt0
+                ok = out["n"] - len(out["errs"])
+                if ok:
+                    with lat_lock:
+                        r_lats.append((rtt, ok))
+                reads_done[t] += ok
+                read_errs[t] += len(out["errs"])
+                if ok == 0:
+                    time.sleep(0.05)
+                sent += n
+            c.close()
+
+        def writer(t):
+            # free-runs until the readers finish: acked writes over
+            # the same wall clock as the reads
+            c = http.client.HTTPConnection(host, port, timeout=120)
+            base = (13 << 50) | (t << 40)
+            seq = 0
+            while readers_live.is_set():
+                reqs = [Request(method="PUT", id=base + seq + j + 1,
+                                path=keys[(seq + j) % n_keys],
+                                val=f"w{seq + j}")
+                        for j in range(w_window)]
+                seq += w_window
+                try:
+                    out = post(c, "/mraft/propose_many",
+                               pack_requests(reqs))
+                except (OSError, http.client.HTTPException):
+                    # a torn write batch's verdicts are unknowable:
+                    # count NOTHING for it (never double-count) and
+                    # continue on a fresh connection + fresh ids
+                    c.close()
+                    c = http.client.HTTPConnection(host, port,
+                                                   timeout=120)
+                    continue
+                writes_acked[t] += out["n"] - len(out["errs"])
+            c.close()
+
+        t0 = time.perf_counter()
+        rts = [threading.Thread(target=reader, args=(t,))
+               for t in range(r_conns)]
+        wts = [threading.Thread(target=writer, args=(t,))
+               for t in range(w_conns)]
+        for t in rts + wts:
+            t.start()
+        for t in rts:
+            t.join()
+        # the measurement wall closes HERE: count only write acks
+        # that landed inside it (the writer's in-flight batch
+        # completes after the wall and must not inflate writes/s)
+        dt = time.perf_counter() - t0
+        reads = sum(reads_done)
+        writes = sum(writes_acked)
+        readers_live.clear()
+        for t in wts:
+            t.join()
+        stats = fetch_read_stats(urls)
+        stats.update(disk_usage(tmp))
+        row = {
+            "bench": "dist_read_mix",
+            "hosts": 3, "groups": G,
+            "read_mix": f"{mix[0]}/{mix[1]}",
+            "reader_conns": r_conns, "writer_conns": w_conns,
+            "window": window, "writer_window": w_window,
+            "pipeline_depth": depth,
+            "lease_ticks": lease_ticks,
+            "reads": reads, "read_errs": sum(read_errs),
+            "writes_acked": writes,
+            "reads_per_sec": round(reads / dt, 0),
+            "writes_acked_per_sec": round(writes / dt, 0),
+            "read_write_ratio": round(reads / max(1, writes), 1),
+            "read_rtt_p50_ms": round(
+                weighted_pct(r_lats, 0.5) * 1e3, 2),
+            "read_rtt_p99_ms": round(
+                weighted_pct(r_lats, 0.99) * 1e3, 2),
+            **stats,
+            "backend": "3 real processes (1-core host)",
+            "wall_s": round(dt, 2),
+        }
+        return row
+    finally:
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+        try:
+            cpu1 = resource.getrusage(resource.RUSAGE_CHILDREN)
+            row["cluster_cpu_s"] = round(
+                cpu1.ru_utime + cpu1.ru_stime
+                - cpu0.ru_utime - cpu0.ru_stime, 2)
+        except NameError:
+            pass
+
+
+def check_read_mix(row: dict) -> None:
+    """The PR-7 acceptance gate on a read-mix row."""
+    assert row["read_errs"] == 0, row
+    ratio = row["reads_per_sec"] / max(1.0,
+                                       row["writes_acked_per_sec"])
+    assert ratio >= 50.0, (
+        f"reads/s {row['reads_per_sec']} < 50x acked-writes/s "
+        f"{row['writes_acked_per_sec']} (ratio {ratio:.1f})")
+    paths = row["read_serves_by_path"]
+    lease = paths.get("lease", 0)
+    assert lease > sum(v for k, v in paths.items() if k != "lease"), \
+        f"lease reads not the dominant serve path: {paths}"
+    assert row["read_index_batch_p50"] > 1, (
+        f"ReadIndex batch p50 {row['read_index_batch_p50']} <= 1 — "
+        f"confirmation is running per-read rounds")
+
+
 SWEEP_DEPTHS = (1, 2, 4, 8, 16)
 
 
@@ -429,8 +701,20 @@ def main() -> None:
     ap.add_argument("--sweep", action="store_true",
                     help="run the pipeline-depth sweep "
                          f"{SWEEP_DEPTHS} and write the artifact")
+    ap.add_argument("--read-mix", default=None, metavar="R/W",
+                    help="read-heavy mode (PR 7), e.g. 95/5: "
+                         "reader conns free-run batched "
+                         "linearizable GETs while writer conns "
+                         "free-run PUTs over the same wall clock")
+    ap.add_argument("--lease-ticks", type=int, default=None,
+                    help="with --read-mix: the nodes' "
+                         "--lease-ticks (0 = lease off, every "
+                         "linearizable read takes ReadIndex)")
     ap.add_argument("--check", action="store_true",
-                    help="with --sweep: assert the >=4x ack-p50 gate")
+                    help="with --sweep: assert the >=4x ack-p50 "
+                         "gate; with --read-mix: assert the PR-7 "
+                         "gate (reads/s >= 50x acked-writes/s, "
+                         "lease dominant, batch p50 > 1)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny loopback run for scripts/test: "
                          "depth 1 vs 8, sanity-only assertions")
@@ -449,6 +733,32 @@ def main() -> None:
             row = run_once(800, 4, 100, depth=depth)
             print(json.dumps(row), flush=True)
             assert row["acked"] == 800, row
+        # read path (PR 7): every batched linearizable GET must
+        # serve, off the zero-WAL lane, with reads outrunning the
+        # concurrent writes; the 50x gate needs the full run's
+        # sample sizes, not a smoke
+        row = run_read_mix(3000, 4, 100, mix=(90, 10))
+        print(json.dumps(row), flush=True)
+        assert row["reads"] == 3000 and row["read_errs"] == 0, row
+        assert sum(row["read_serves_by_path"].values()) >= 3000, row
+        assert row["reads_per_sec"] > row["writes_acked_per_sec"], \
+            row
+        return
+    if args.read_mix:
+        r, w = (int(x) for x in args.read_mix.split("/"))
+        row = run_read_mix(args.total, args.conns, args.window,
+                           mix=(r, w), depth=args.depth,
+                           lease_ticks=args.lease_ticks)
+        print(json.dumps(row), flush=True)
+        if args.out_dir:
+            os.makedirs(args.out_dir, exist_ok=True)
+            ts = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+            path = os.path.join(args.out_dir,
+                                f"dist_read_mix_{ts}.json")
+            with open(path, "w") as f:
+                json.dump(row, f, indent=1, sort_keys=True)
+        if args.check:
+            check_read_mix(row)
         return
     if args.sweep:
         run_sweep(args.total, args.conns, args.window,

@@ -44,6 +44,10 @@ def main() -> None:
                     help="max in-flight append frames per peer "
                          "(1 = lockstep-equivalent)")
     ap.add_argument("--coalesce-us", type=int, default=2000)
+    ap.add_argument("--lease-ticks", type=int, default=30,
+                    help="leader-lease length in ticks for "
+                         "linearizable reads (< election - drift; "
+                         "0 = lease off, ReadIndex-only)")
     ap.add_argument("--snap-count", type=int, default=None,
                     help="applies between snapshots (snapshot + "
                          "segment GC cadence; default 10000)")
@@ -59,7 +63,8 @@ def main() -> None:
                      election=60,
                      pipeline_depth=args.pipeline_depth,
                      coalesce_us=args.coalesce_us,
-                     snap_count=args.snap_count)
+                     snap_count=args.snap_count,
+                     lease_ticks=args.lease_ticks)
     srv.start()
 
     # SIGUSR1 dumps the tracer span table to stdout (profiling a real

@@ -930,6 +930,87 @@ def test_timeouts_tables_match_real_signatures():
     assert isinstance(d, _ast.Constant) and d.value == 10
 
 
+def test_timeouts_lease_band_fires_on_call_site(tmp_path):
+    root = _fixture_root(tmp_path, "etcd_tpu/server/boot.py", """
+        from etcd_tpu.server.distserver import DistServer
+
+        def build(d):
+            return DistServer(
+                d, slot=0,
+                peer_urls=["u0", "u1", "u2"],
+                election=10, lease_ticks=9)   # 9 >= 10 - 1
+    """)
+    findings = run_checkers(root, [TimeoutBandChecker()])
+    assert [f.rule for f in findings] == ["lease-band"]
+    assert "lease_ticks=9" in findings[0].message
+
+
+def test_timeouts_lease_band_fires_on_argparse_defaults(tmp_path):
+    root = _fixture_root(tmp_path, "etcd_tpu/server/boot.py", """
+        import argparse
+
+        def build_parser():
+            p = argparse.ArgumentParser()
+            p.add_argument("--dist-election-ticks", type=int,
+                           default=60)
+            p.add_argument("--dist-lease-ticks", type=int,
+                           default=58)     # 58 >= 60 - 6
+            return p
+    """)
+    findings = run_checkers(root, [TimeoutBandChecker()])
+    assert [f.rule for f in findings] == ["lease-band"]
+    assert "--dist-lease-ticks" in findings[0].message
+
+
+def test_timeouts_lease_band_quiet_on_banded_and_dynamic(tmp_path):
+    root = _fixture_root(tmp_path, "etcd_tpu/server/boot.py", """
+        import argparse
+
+        from etcd_tpu.server.distserver import DistServer
+
+        def build(d, lease_dyn):
+            a = DistServer(d, slot=0,
+                           peer_urls=["u0", "u1", "u2"],
+                           election=10, lease_ticks=5)  # in band
+            b = DistServer(d, slot=0,
+                           peer_urls=["u0", "u1", "u2"],
+                           election=10, lease_ticks=0)  # disabled
+            c = DistServer(d, slot=0,
+                           peer_urls=["u0", "u1", "u2"],
+                           election=10,
+                           lease_ticks=lease_dyn)       # dynamic
+            # the constructor clamps election up to len(peer_urls):
+            # lease 9 clears the CLAMPED band [12 - 1)
+            e = DistServer(d, slot=0,
+                           peer_urls=["u0", "u1", "u2", "u3", "u4",
+                                      "u5", "u6", "u7", "u8", "u9",
+                                      "ua", "ub"],
+                           election=12, lease_ticks=9)
+            return a, b, c, e
+
+        def build_parser():
+            p = argparse.ArgumentParser()
+            p.add_argument("--dist-election-ticks", type=int,
+                           default=60)
+            p.add_argument("--dist-lease-ticks", type=int,
+                           default=30)     # 30 < 60 - 6
+            p.add_argument("--lease-off", type=int, default=0)
+            return p
+    """)
+    assert run_checkers(root, [TimeoutBandChecker()]) == []
+
+
+def test_timeouts_lease_drift_matches_runtime():
+    """Drift-guard: the checker's stdlib-only copy of the drift
+    formula must equal the runtime's (server/readindex.py) — the
+    static band and the constructor validation may never diverge."""
+    from etcd_tpu.analysis.timeouts import _lease_drift
+    from etcd_tpu.server.readindex import lease_drift_ticks
+
+    for e in (1, 2, 5, 9, 10, 11, 59, 60, 61, 100, 1000):
+        assert _lease_drift(e) == lease_drift_ticks(e), e
+
+
 def test_timeouts_quiet_on_banded_configs(tmp_path):
     root = _fixture_root(tmp_path, "etcd_tpu/server/boot.py", """
         import argparse

@@ -35,7 +35,13 @@ def put(srv, key, val, timeout=10.0):
 
 
 def get(srv, key):
-    return srv.do(Request(method="GET", id=rid(), path=key))
+    # serializable on purpose: this suite's GETs assert what THIS
+    # host's replica holds (replication progress, restart catch-up,
+    # partition divergence) — the pre-PR-7 local-read semantics,
+    # reachable only via the explicit opt-out.  Linearizable-read
+    # behavior is covered by tests/test_readindex.py.
+    return srv.do(Request(method="GET", id=rid(), path=key,
+                          serializable=True))
 
 
 def wait_for(pred, timeout=15.0, msg="condition"):
