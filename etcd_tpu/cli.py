@@ -302,6 +302,15 @@ def start_dist(args, explicit: set[str]) -> int:
         log.error("%s", e)
         return 1
     s.start()
+    # flight-recorder crash dump (PR 8): SIGTERM or an unhandled
+    # crash writes the black-box ring next to the data dir (or
+    # ETCD_FLIGHT_DIR) — what the chaos drill's post-mortem reads
+    # when a node died before its ring could be harvested over HTTP
+    from .obs.flight import install_crash_dump
+
+    install_crash_dump(s.flight,
+                       os.environ.get("ETCD_FLIGHT_DIR")
+                       or os.path.join(data_dir, "trace_artifacts"))
     if args.dist_slot == 0 and s.fresh:
         # slot 0 bootstraps leadership for a BRAND-NEW cluster only
         # (fresh = no prior WAL); a restarted slot 0 must rejoin via

@@ -21,6 +21,15 @@ The record path is a couple of counter adds — safe inside serving
 loops.  NOTHING here may run inside a traced function (the
 tracer-purity checker's domain): callers wrap the *dispatch call
 site*, never the traced body.
+
+Stage attribution (PR 8): when a ledger seam runs inside an active
+``tracer.stage(...)`` context, its device wall seconds are charged
+ONCE to that stage's ``etcd_stage_seconds{kind="device"}`` column
+(via utils/trace.note_device_seconds).  A ``dispatch`` seam charges
+its whole window at exit; ``block``/``fetch`` charge only when no
+dispatch seam is active on the thread — a block inside a dispatch is
+already inside the dispatch's window, and charging both would
+double-count the very seconds this split exists to make honest.
 """
 
 from __future__ import annotations
@@ -30,6 +39,21 @@ import time
 from contextlib import contextmanager
 
 from .metrics import Registry, registry as default_registry
+
+_tls = threading.local()  # per-thread active-dispatch depth
+
+# utils/trace imports obs.metrics; importing it lazily here keeps
+# obs importable before utils and avoids a cycle at package init
+_note_device = None
+
+
+def _charge_stage(dt: float) -> None:
+    global _note_device
+    if _note_device is None:
+        from ..utils.trace import note_device_seconds
+
+        _note_device = note_device_seconds
+    _note_device(dt)
 
 
 def nbytes_of(x) -> int:
@@ -77,14 +101,24 @@ class DeviceLedger:
 
     @contextmanager
     def dispatch(self, stage: str):
-        """Time one pass through a jitted-dispatch seam."""
+        """Time one pass through a jitted-dispatch seam.  The
+        window is charged to the enclosing stage()'s device column
+        at exit (module docstring)."""
         s = self._stage(stage)
+        depth = getattr(_tls, "dispatch_depth", 0)
+        _tls.dispatch_depth = depth + 1
         t0 = time.perf_counter()
         try:
             yield s
         finally:
+            dt = time.perf_counter() - t0
+            _tls.dispatch_depth = depth
             s.dispatches.inc()
-            s.dispatch_seconds.inc(time.perf_counter() - t0)
+            s.dispatch_seconds.inc(dt)
+            if depth == 0:
+                # outermost seam only: a nested dispatch's window is
+                # inside ours already
+                _charge_stage(dt)
 
     def h2d(self, stage: str, *values) -> None:
         n = sum(nbytes_of(v) for v in values)
@@ -104,7 +138,10 @@ class DeviceLedger:
         s = self._stage(stage)
         t0 = time.perf_counter()
         out = jax.block_until_ready(value)
-        s.block_seconds.inc(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        s.block_seconds.inc(dt)
+        if not getattr(_tls, "dispatch_depth", 0):
+            _charge_stage(dt)
         return out
 
     def fetch(self, stage: str, value):
@@ -115,8 +152,11 @@ class DeviceLedger:
         s = self._stage(stage)
         t0 = time.perf_counter()
         out = np.asarray(value)
-        s.block_seconds.inc(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        s.block_seconds.inc(dt)
         s.d2h_bytes.inc(out.nbytes)
+        if not getattr(_tls, "dispatch_depth", 0):
+            _charge_stage(dt)
         return out
 
     def snapshot(self) -> dict:

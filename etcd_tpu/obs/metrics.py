@@ -214,6 +214,37 @@ _DEFS = (
         "(lease serves land in the first buckets; ReadIndex serves "
         "pay the piggybacked confirmation round).", window=4096),
     MetricDef(
+        "etcd_stage_seconds", "histogram",
+        "Per-stage attribution of the serving loops (PR 8 stage() "
+        "facade): one sample per pass through a labeled stage, "
+        "split by kind — wall (perf_counter span), cpu "
+        "(time.thread_time delta: CPU this thread actually burned "
+        "inside the stage) and device (devledger-attributed "
+        "dispatch/block seconds inside the stage, charged here "
+        "ONCE so wall/cpu/device columns sum honestly instead of "
+        "the ledger and the span double-counting the window).",
+        labels=("stage", "kind"), window=512),
+    MetricDef(
+        "etcd_trace_spans_total", "counter",
+        "Stage passes recorded by the stage() facade, per stage "
+        "(the denominator for the etcd_stage_seconds sums).",
+        labels=("stage",)),
+    MetricDef(
+        "etcd_flight_events_total", "counter",
+        "Flight-recorder events recorded, by event class: span "
+        "(per-proposal trace span), frame (peerlink send/recv/"
+        "resp/ack edge of a traced frame), election, pipe_mode "
+        "(REPLICATE/PROBE/SNAPSHOT transition), lease_loss, "
+        "read_fail (fail-closed read), snap_install, tail "
+        "(slow/failed proposal or read captured past head "
+        "sampling).", labels=("class",)),
+    MetricDef(
+        "etcd_trace_drop_total", "counter",
+        "Trace/flight events dropped, by reason: ring_overflow "
+        "(the bounded ring overwrote its oldest event — size it "
+        "with ETCD_FLIGHT_RING), unsampled is NOT counted (head "
+        "sampling is a rate, not a loss).", labels=("reason",)),
+    MetricDef(
         "etcd_lint_findings", "gauge",
         "Findings per checker in the last static-analysis run "
         "(baselined findings included; suppressed ones not).",

@@ -46,7 +46,8 @@ SNAPSHOT = "snapshot"
 class FrameMeta:
     """One in-flight append frame's accounting record."""
 
-    __slots__ = ("seq", "epoch", "t0", "nbytes", "has_ents", "stripe")
+    __slots__ = ("seq", "epoch", "t0", "nbytes", "has_ents", "stripe",
+                 "traced")
 
     def __init__(self, seq: int, epoch: int, t0: float, nbytes: int,
                  has_ents: bool, stripe: int):
@@ -56,6 +57,10 @@ class FrameMeta:
         self.nbytes = nbytes
         self.has_ents = has_ents
         self.stripe = stripe
+        # the frame carries a distributed-trace block (PR 8): its
+        # matched ack is a flight-recorder frame event (the
+        # send/ack half of the stitcher's clock-alignment pairs)
+        self.traced = False
 
 
 class _PeerPipe:
@@ -132,46 +137,60 @@ class AppendPipeline:
             return "stale_seq", None
         return "ok", meta
 
-    def note_reject(self, peer: int) -> None:
+    def note_reject(self, peer: int) -> bool:
         """A lane in a matched response rejected: the follower found
         a gap (out-of-order or dropped frame).  Collapse to PROBE so
         the repair goes out as ONE catch-up frame, not a window of
         doomed optimistic sends.  A SNAPSHOT peer stays SNAPSHOT —
         it is behind the compaction point, so probing cannot repair
-        it either; only the install can."""
+        it either; only the install can.  Returns True when the mode
+        actually changed (the caller records the transition in the
+        flight ring)."""
         pp = self._peers[peer]
-        if pp.mode != SNAPSHOT:
-            pp.mode = PROBE
+        if pp.mode in (SNAPSHOT, PROBE):
+            return False
+        pp.mode = PROBE
+        return True
 
-    def note_ok(self, peer: int) -> None:
+    def note_ok(self, peer: int) -> bool:
         """A matched response appended cleanly: (re)open the window.
         SNAPSHOT is sticky here by design: a need-snap lane acks
         POSITIVELY at its commit (distmember.handle_append), so an
         ok ack proves nothing about the peer having crossed the
         compaction point — only :meth:`note_caught_up` (called when a
         pump-time build shows no need-snap lanes) reopens the
-        window."""
+        window.  Returns True on an actual transition."""
         pp = self._peers[peer]
-        if pp.mode != SNAPSHOT:
-            pp.mode = REPLICATE
+        if pp.mode in (SNAPSHOT, REPLICATE):
+            return False
+        pp.mode = REPLICATE
+        return True
 
-    def note_snapshot(self, peer: int) -> None:
+    def note_snapshot(self, peer: int) -> bool:
         """Every sendable lane for this peer is behind the leader's
         compaction point: stop building append windows (they would
         all be doomed need-snap frames) and hold one notification
         frame in flight at heartbeat cadence until the peer's
-        streamed install lands."""
-        self._peers[peer].mode = SNAPSHOT
+        streamed install lands.  Returns True on an actual
+        transition."""
+        pp = self._peers[peer]
+        if pp.mode == SNAPSHOT:
+            return False
+        pp.mode = SNAPSHOT
+        return True
 
-    def note_caught_up(self, peer: int) -> None:
+    def note_caught_up(self, peer: int) -> bool:
         """A pump-time build_append saw the peer past the compaction
         point again (its streamed install landed and the positive
         need-snap ack advanced match/next): leave SNAPSHOT via ONE
         confirming probe frame rather than a full optimistic window
-        against a freshly-installed log."""
+        against a freshly-installed log.  Returns True on an actual
+        transition."""
         pp = self._peers[peer]
-        if pp.mode == SNAPSHOT:
-            pp.mode = PROBE
+        if pp.mode != SNAPSHOT:
+            return False
+        pp.mode = PROBE
+        return True
 
     def fail(self, peer: int, seqs) -> list[FrameMeta]:
         """Transport failure: the listed frames will never be acked.

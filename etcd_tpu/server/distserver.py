@@ -48,6 +48,7 @@ import numpy as np
 
 from ..obs import metrics as _obs
 from ..obs.devledger import ledger as _ledger
+from ..obs.flight import FlightRecorder
 from ..raft.distmember import DistMember
 from ..snap import NoSnapshotError, Snapshotter
 from ..snap.stream import (
@@ -114,14 +115,16 @@ K_BALLOT = 2     # durable term/vote: [G] terms + [G] votes
 
 
 class _Pending:
-    __slots__ = ("req", "data", "id", "retries", "group")
+    __slots__ = ("req", "data", "id", "retries", "group", "trace")
 
-    def __init__(self, req, data, id, group=None):
+    def __init__(self, req, data, id, group=None, trace=None):
         self.req, self.data, self.id = req, data, id
         self.retries = 0
         # explicit group routing (ConfChange entries target a group
         # directly instead of hashing a client path)
         self.group = group
+        # head-sampled distributed-trace id (PR 8; None = untraced)
+        self.trace = trace
 
 
 class DistServer:
@@ -459,6 +462,22 @@ class DistServer:
         self._m_read_rtt = _obs.registry.histogram(
             "etcd_read_rtt_seconds")
         self._read_ctrs: dict[tuple[str, str], object] = {}
+
+        # -- tracing + flight recorder (PR 8) -------------------------
+        # Per-server ring: in-process test clusters must not mix
+        # three servers' events in one ring (the stitcher keys on the
+        # node).  ETCD_TRACE_SAMPLE (head sampling 1-in-N; 0 = trace
+        # off), ETCD_FLIGHT_RING (capacity) and ETCD_TRACE_SLOW_MS
+        # (tail-capture threshold) are read by the recorder.
+        self.flight = FlightRecorder(node=self.name, slot=slot)
+        # (group, gindex) -> trace_id for in-flight TRACED proposals
+        # (sampled subset of _ack_clock's keys; guarded by self.lock)
+        self._trace_live: dict[tuple[int, int], int] = {}
+        # (peer, seq) -> [[trace, origin], ...] for frames whose
+        # trace block is in the channel queue: the peerlink on_sent
+        # callback pops this (GIL-atomic) and stamps the flight
+        # frame event at the actual socket write
+        self._traced_send: dict[tuple[int, int], list] = {}
 
         self.mr = DistMember(g, self.m, slot, cap,
                              election=election,
@@ -852,8 +871,17 @@ class DistServer:
         """POST /mraft: one batched consensus frame in, the response
         frame out.  Everything this host learned is durable before
         the response bytes leave (Ready contract ordering)."""
-        with tracer.span("dist.frame_unmarshal"):
+        t_recv = time.monotonic()
+        with tracer.stage("dist.frame_unmarshal"):
             msg = unmarshal_any(data)
+        traced = (isinstance(msg, AppendBatch) and msg.trace) or None
+        if traced:
+            # the receive edge of the stitcher's clock-alignment
+            # pair, stamped BEFORE the lock (symmetric with the
+            # leader's off-lock socket-write/ack stamps)
+            self.flight.record(
+                "frame", t=t_recv, dir="recv", src=msg.sender,
+                seq=msg.seq, traces=[[t[2], t[3]] for t in traced])
         with self.lock, tracer.span("dist.handle_frame"):
             if self.done.is_set():
                 # stop() closes the WAL under this lock with done
@@ -864,7 +892,7 @@ class DistServer:
                 raise ServerStoppedError()
             if isinstance(msg, AppendBatch):
                 self.server_stats.recv_append()
-                with tracer.span("dist.handle_append"), \
+                with tracer.stage("dist.handle_append"), \
                         _ledger.dispatch("dist.handle_append"):
                     resp = self.mr.handle_append(msg)
                 # the ballot record (if the term changed in this
@@ -873,7 +901,7 @@ class DistServer:
                 # carries ballot + entries (a later seq on disk
                 # before earlier ones reads as an index gap on the
                 # next restart — found by the chaos drill)
-                with tracer.span("dist.frame_records"):
+                with tracer.stage("dist.frame_records"):
                     recs = self._ballot_record()
                     for gi in np.nonzero(resp.appended)[0]:
                         for j in range(int(msg.n_ents[gi])):
@@ -887,8 +915,23 @@ class DistServer:
                                     gterm=int(msg.ent_terms[gi, j]),
                                     payload=msg.payloads[gi][j])
                                 .marshal()))
-                with tracer.span("dist.frame_persist"):
+                with tracer.stage("dist.frame_persist"):
                     self._persist(recs)
+                if traced:
+                    # one fsync covered the whole batch: every traced
+                    # entry whose lane actually appended is durable
+                    # on this follower as of NOW.  Lane index is
+                    # bounds-checked — a malformed trace block must
+                    # degrade to a missing span, never a handler 500.
+                    t_sync = time.monotonic()
+                    appended = resp.appended
+                    for g_, gi_, tid, org in traced:
+                        if appended is not None \
+                                and 0 <= g_ < self.g \
+                                and appended[g_]:
+                            self.flight.span(tid, org,
+                                             "follower_fsync",
+                                             t=t_sync, host=self.slot)
                 if bool(np.any(msg.need_snap & msg.active)):
                     if log.isEnabledFor(logging.DEBUG):
                         log.debug("dist[%d]: need_snap frame from %d "
@@ -896,13 +939,16 @@ class DistServer:
                                   np.nonzero(msg.need_snap
                                              & msg.active)[0].tolist())
                     self._need_pull = True
-                with tracer.span("dist.frame_apply"):
+                with tracer.stage("dist.frame_apply"):
                     self._apply_committed()
                 # echo the pipeline tags: the leader matches this ack
                 # to its in-flight frame by (epoch, seq)
                 resp.seq, resp.epoch = msg.seq, msg.epoch
-                with tracer.span("dist.frame_marshal_resp"):
+                with tracer.stage("dist.frame_marshal_resp"):
                     out = resp.marshal()
+                if traced:
+                    self.flight.record("frame", dir="resp",
+                                       src=msg.sender, seq=msg.seq)
                 return out
             if isinstance(msg, VoteReq):
                 resp = self.mr.handle_vote(msg)
@@ -1031,8 +1077,14 @@ class DistServer:
         if not lead[gi]:
             return "not_leader", gi
         ch = self.w.register(r.id)
+        # head sampling at client ingest: the trace context is born
+        # HERE and rides the _Pending through the coalescer, the
+        # engine append, the DGB2 frames and the apply/ack path
+        tid = self.flight.sample_trace()
+        if tid is not None:
+            self.flight.span(tid, self.slot, "ingest", group=gi)
         self._queue.put(_Pending(req=r, data=r.marshal(), id=r.id,
-                                 group=gi))
+                                 group=gi, trace=tid))
         return "ch", ch
 
     def _await_ack(self, rid: int, ch,
@@ -1144,8 +1196,18 @@ class DistServer:
         c.inc(n)
         if outcome == "ok":
             self.store.stats.inc_read_path(path, n)
+        else:
+            # every fail-closed read's CAUSE lands in the flight ring
+            # (the linz drill's "why did reads reject" forensics)
+            self.flight.record("read_fail", path=path,
+                               outcome=outcome, n=n)
         if t0 is not None:
-            self._m_read_rtt.observe(time.monotonic() - t0)
+            dt = time.monotonic() - t0
+            self._m_read_rtt.observe(dt)
+            if dt > self.flight.slow_s:
+                self.flight.record("tail", kind="slow_read",
+                                   path=path, n=n,
+                                   rtt_ms=round(dt * 1e3, 2))
 
     def _group_cached(self, path: str) -> int:
         """group_of with the namespace cache (read hot path)."""
@@ -1787,21 +1849,38 @@ class DistServer:
                 # belongs to the old reign — drop them and let their
                 # late acks read stale_epoch
                 dropped = self.pipe.bump_epoch()
+                self._traced_send.clear()  # old reign's send stamps
                 if dropped:
                     _obs.registry.counter(
                         "etcd_dist_frame_resend_total",
                         reason="stale_epoch").inc(dropped)
+            if lost_lead.any():
+                # black-box forensics: a deposed lane also loses its
+                # lease cover — this event is what lets the stitcher
+                # and the drill see WHY reads started failing closed
+                self.flight.record(
+                    "lease_loss",
+                    lanes=int(lost_lead.sum()),
+                    first=np.nonzero(lost_lead)[0][:8].tolist())
             if lost_lead.any() and self._assigned:
                 # waiters on lanes we no longer lead can never be
                 # acked by us (the new leader may truncate them)
                 for key in [k for k in self._assigned
                             if lost_lead[k[0]]]:
                     p = self._assigned.pop(key)
+                    self.flight.record("tail", kind="failed_proposal",
+                                       group=key[0], gindex=key[1],
+                                       cause="leadership_lost",
+                                       trace=p.trace)
                     self.w.trigger(p.id, None)
             if lost_lead.any() and self._ack_clock:
                 # deposed lanes' in-flight stamps can never ack here
                 self._ack_clock = {
                     k: v for k, v in self._ack_clock.items()
+                    if not lost_lead[k[0]]}
+            if lost_lead.any() and self._trace_live:
+                self._trace_live = {
+                    k: v for k, v in self._trace_live.items()
                     if not lost_lead[k[0]]}
             if lost_lead.any() and self._reads.pending:
                 # reads pending on deposed lanes can never be
@@ -1857,7 +1936,7 @@ class DistServer:
             new_keys: list[tuple[int, int]] = []
             recs: list[Entry] = []
             if n_new.any():
-                with tracer.span("dist.propose"), \
+                with tracer.stage("dist.propose"), \
                         _ledger.dispatch("dist.propose"):
                     valid, base = mr.propose(
                         n_new, data=[[p.data for p in items[gi]]
@@ -1872,12 +1951,24 @@ class DistServer:
                             if p.retries < 50:
                                 self._requeue[gi].append(p)
                             else:
+                                self.flight.record(
+                                    "tail", kind="failed_proposal",
+                                    group=gi, cause="retry_exhausted",
+                                    trace=p.trace)
                                 self.w.trigger(p.id, None)
                         continue
                     for j, p in enumerate(items[gi]):
                         key = (gi, int(base[gi]) + 1 + j)
                         self._assigned[key] = p
                         new_keys.append(key)
+                        if p.trace is not None:
+                            # the traced proposal now has a log slot:
+                            # frames carrying (gi, gindex) will ship
+                            # its trace context to the followers
+                            self._trace_live[key] = p.trace
+                            self.flight.span(
+                                p.trace, self.slot, "append",
+                                group=gi, gindex=key[1])
                 recs = self._entry_records(
                     [gi for gi in range(self.g)
                      if items[gi] and valid[gi]], base, items)
@@ -1895,7 +1986,7 @@ class DistServer:
             # frames FIRST (the fsync/network overlap): the channel
             # writer threads ship them — and the followers append +
             # fsync — while our own WAL fsync below is still running
-            with tracer.span("dist.build_append"), \
+            with tracer.stage("dist.build_append"), \
                     _ledger.dispatch("dist.build_append"):
                 self._pump_all()
 
@@ -1905,19 +1996,26 @@ class DistServer:
                 # time readable off /metrics (dispatch_seconds =
                 # fsync seconds that ran with frames in flight)
                 if self.pipe.inflight_total():
-                    with tracer.span("dist.persist"), \
+                    with tracer.stage("dist.persist"), \
                             _ledger.dispatch("dist.fsync_overlap"):
                         self._persist(recs)
                 else:
-                    with tracer.span("dist.persist"):
+                    with tracer.stage("dist.persist"):
                         self._persist(recs)
                 # fsync landed: NOW this host's copy joins the quorum
                 mr.ack_self(np.asarray(mr.state.last))
+                if self._trace_live and new_keys:
+                    now_f = time.monotonic()
+                    for key in new_keys:
+                        tid = self._trace_live.get(key)
+                        if tid is not None:
+                            self.flight.span(tid, self.slot,
+                                             "leader_fsync", t=now_f)
             else:
                 # nothing appended here, but acks may have moved the
                 # commit frontier since the last flush
                 self._persist([])
-            with tracer.span("dist.apply"):
+            with tracer.stage("dist.apply"):
                 self._apply_committed(self._assigned)
             # read maintenance: drop waiters whose callers timed out
             # (the age bound sits ABOVE the 30s get_many handler
@@ -1949,6 +2047,8 @@ class DistServer:
                     self._on_pipe_resp(_p, seq, status, body),
                 on_fail=lambda seqs, reason, _p=peer:
                     self._on_pipe_fail(_p, seqs, reason),
+                on_sent=lambda seq, _p=peer:
+                    self._on_pipe_sent(_p, seq),
                 name=f"{self.slot}to{peer}")
             self._channels[peer] = chan
         return chan
@@ -2030,6 +2130,23 @@ class DistServer:
                     stripe=stripe)
                 b.seq, b.epoch = meta.seq, self.pipe.epoch
                 mr.optimistic_advance(peer, b)
+                if has_ents and self._trace_live:
+                    # stamp the frame with every in-flight traced
+                    # proposal it carries (the sampled subset only:
+                    # _trace_live holds tens of keys, not the batch)
+                    prev = np.asarray(b.prev_idx)
+                    act = np.asarray(b.active) \
+                        & ~np.asarray(b.need_snap)
+                    tr = [(g_, gi_, tid, self.slot)
+                          for (g_, gi_), tid
+                          in self._trace_live.items()
+                          if act[g_] and prev[g_] < gi_
+                          <= prev[g_] + int(n_ents[g_])]
+                    if tr:
+                        b.trace = tr
+                        meta.traced = True
+                        self._traced_send[(peer, meta.seq)] = \
+                            [[t[2], t[3]] for t in tr]
                 payload = b.marshal()
                 meta.nbytes = len(payload)
                 self._m_frames.inc()
@@ -2046,13 +2163,30 @@ class DistServer:
             if not saw_appendable:
                 log.debug("dist[%d]: peer %d all lanes need-snap",
                           self.slot, peer)
-                self.pipe.note_snapshot(peer)
+                if self.pipe.note_snapshot(peer):
+                    self.flight.record("pipe_mode", peer=peer,
+                                       mode="snapshot")
             else:
                 # the peer is past the compaction point on at least
                 # one lane again (its install landed): leave
                 # SNAPSHOT via one confirming probe frame
-                self.pipe.note_caught_up(peer)
+                if self.pipe.note_caught_up(peer):
+                    self.flight.record("pipe_mode", peer=peer,
+                                       mode="probe",
+                                       cause="caught_up")
         self._set_inflight(peer)
+
+    def _on_pipe_sent(self, peer: int, seq: int) -> None:
+        """Channel writer callback: the frame's bytes just hit the
+        socket.  Record the flight send event for traced frames —
+        this is the accurate send edge of the stitcher's symmetric
+        (send, recv, resp, ack) clock-alignment quads (stamping at
+        register time would fold channel queue wait into the
+        network hop).  dict.pop is GIL-atomic; no lock needed."""
+        traces = self._traced_send.pop((peer, seq), None)
+        if traces is not None:
+            self.flight.record("frame", dir="send", peer=peer,
+                               seq=seq, traces=traces)
 
     def _on_pipe_resp(self, peer: int, seq: int, status: int,
                       body: bytes) -> None:
@@ -2087,10 +2221,19 @@ class DistServer:
         repaired it."""
         if self.done.is_set():
             return
+        for seq in seqs:
+            # a never-sent (or never-acked) traced frame's send
+            # registration must not leak in the stamp dict
+            self._traced_send.pop((peer, seq), None)
         with self.lock:
+            was = self.pipe.mode(peer)
             popped = self.pipe.fail(peer, seqs)
             if not popped:
                 return
+            mode = self.pipe.mode(peer)
+            if mode != was:
+                self.flight.record("pipe_mode", peer=peer, mode=mode,
+                                   cause=reason)
             _obs.registry.counter("etcd_dist_frame_resend_total",
                                   reason=reason).inc(len(popped))
             self._m_send_fail.inc(len(popped))
@@ -2128,7 +2271,13 @@ class DistServer:
         rtt = t1 - meta.t0
         self._m_send_rtt.observe(rtt)
         self.leader_stats.observe(self._member_id(peer), rtt)
-        with tracer.span("dist.absorb"), \
+        if meta.traced:
+            # the ack edge of the clock-alignment quad (t1 was
+            # stamped on the channel reader thread, pre-lock)
+            self.flight.record("frame", t=t1, dir="ack", peer=peer,
+                               seq=resp.seq)
+            self._traced_send.pop((peer, resp.seq), None)
+        with tracer.stage("dist.absorb"), \
                 _ledger.dispatch("dist.absorb"):
             mr.handle_append_resp(resp)
         active = np.asarray(resp.active)
@@ -2147,13 +2296,17 @@ class DistServer:
             # follower found a gap (dropped or out-of-order frame):
             # next_ was repaired from its commit hint; collapse to
             # PROBE so exactly one catch-up frame goes out
-            self.pipe.note_reject(peer)
+            if self.pipe.note_reject(peer):
+                self.flight.record("pipe_mode", peer=peer,
+                                   mode="probe", cause="reject")
             _obs.registry.counter("etcd_dist_frame_resend_total",
                                   reason="reject").inc()
         elif (active & ok).any():
-            self.pipe.note_ok(peer)
+            if self.pipe.note_ok(peer):
+                self.flight.record("pipe_mode", peer=peer,
+                                   mode="replicate")
         self._set_inflight(peer)
-        with tracer.span("dist.apply"):
+        with tracer.stage("dist.apply"):
             self._apply_committed(self._assigned)
         self._pump_peer(peer)
         # the ack may have advanced the quorum basis past pending
@@ -2177,6 +2330,16 @@ class DistServer:
         with self.lock:
             won = self.mr.tally(req.active, votes)
             self._m_wins.inc(int(won.sum()))
+            # election forensics in the black box: which lanes
+            # campaigned, how many answered, how many lanes won, at
+            # what term — the always-on record the drill's post-
+            # mortem used to grep stdout for
+            fired = np.asarray(req.active)
+            self.flight.record(
+                "election", fired=int(fired.sum()),
+                won=int(won.sum()), resps=len(votes),
+                term=int(np.asarray(self.mr.state.term).max()),
+                lanes=np.nonzero(fired)[0][:8].tolist())
             self._persist_ballot()
             lost = int(np.asarray(req.active).sum()) \
                 - int(won.sum())
@@ -2305,9 +2468,25 @@ class DistServer:
             for idx in range(int(self.applied[gi]) + 1,
                              int(commit[gi]) + 1):
                 # quorum-acked and applying: close the ack-RTT clock
-                ts = self._ack_clock.pop((int(gi), idx), None)
+                key = (int(gi), idx)
+                ts = self._ack_clock.pop(key, None)
+                rtt = None
                 if ts is not None:
-                    self._m_ack.observe(time.perf_counter() - ts)
+                    rtt = time.perf_counter() - ts
+                    self._m_ack.observe(rtt)
+                tid = self._trace_live.pop(key, None) \
+                    if self._trace_live else None
+                if tid is not None:
+                    self.flight.span(tid, self.slot, "commit",
+                                     group=key[0], gindex=key[1])
+                if rtt is not None and rtt > self.flight.slow_s:
+                    # TAIL capture: a slow proposal lands in the ring
+                    # even when head sampling missed it — the ring
+                    # always holds the outliers
+                    self.flight.record("tail", kind="slow_proposal",
+                                       group=key[0], gindex=key[1],
+                                       rtt_ms=round(rtt * 1e3, 2),
+                                       trace=tid)
                 payload = mr.committed_payload(int(gi), idx)
                 resp = None
                 if payload:
@@ -2326,11 +2505,15 @@ class DistServer:
                     else:
                         resp = apply_request_to_store(self.store, r)
                 self.raft_index += 1
+                if tid is not None:
+                    self.flight.span(tid, self.slot, "apply")
                 p = (assigned or {}).pop((int(gi), idx), None)
                 if p is not None:
                     self.w.trigger(p.id, resp)
                 elif payload:
                     self.w.trigger(r.id, resp)
+                if tid is not None:
+                    self.flight.span(tid, self.slot, "client_ack")
             self.applied[gi] = commit[gi]
             if (self._first_apply_at[gi] == 0.0
                     and self._elected_at[gi] > 0.0
@@ -2390,7 +2573,7 @@ class DistServer:
                 d = self._snapshot_dict()
                 term = self.raft_term
             blob = json.dumps(d).encode()
-            with tracer.span("dist.snapshot"):
+            with tracer.stage("dist.snapshot"):
                 # only this process's snapshot() writes the snap dir,
                 # and _snap_mutex is held: safe outside self.lock
                 self.ss.save_snap(Snapshot(
@@ -2423,12 +2606,17 @@ class DistServer:
                 log.exception("dist[%d]: deferred snapshot failed",
                               self.slot)
 
-    @staticmethod
-    def _install_ctr(outcome: str):
+    def _install_ctr(self, outcome: str):
         # the one copy of the outcome-counter lookup lives with the
-        # stream module (it bills chunk_reject there)
+        # stream module; every outcome fetched here is inc'd at the
+        # call site, so recording the flight event at fetch keeps
+        # install outcomes in the black box without touching each of
+        # the eight call sites.  chunk_reject is billed INSIDE the
+        # puller (snap/stream.py) and reaches the ring through the
+        # on_reject hook _stream_snapshot wires up.
         from ..snap.stream import _install_ctr
 
+        self.flight.record("snap_install", outcome=outcome)
         return _install_ctr(outcome)
 
     def _pull_snapshot_bg(self) -> None:
@@ -2530,6 +2718,9 @@ class DistServer:
             timeout=self.post_timeout,
             window=4, deadline_s=deadline,
             abort=self.done.is_set,
+            on_reject=lambda k: self.flight.record(
+                "snap_install", outcome="chunk_reject", chunk=k,
+                donor=h),
             name=f"snap{self.slot}from{h}")
         try:
             return puller.run()
@@ -2945,6 +3136,12 @@ def _make_peer_handler(server: DistServer):
                 # scripts/dist_bench.py pools the three hosts'
                 # ack-RTT buckets from here
                 self._reply(200, _obs.registry.snapshot_json())
+            elif self.path == "/mraft/obs/flight":
+                # flight-recorder dump (PR 8): the ring + clock
+                # anchors + per-stage wall/cpu/device sums — what
+                # chaos_drill harvests on gate failure and
+                # scripts/trace_stitch.py merges across nodes
+                self._reply(200, server.flight.dump_json())
             elif self.path == "/mraft/leaders":
                 # leadership-transition trace for the chaos drill's
                 # recovery decomposition; lock-free reads of small

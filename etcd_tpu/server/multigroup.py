@@ -575,7 +575,7 @@ class MultiGroupServer:
                 self._absorb_commits({})
                 continue
 
-            with tracer.span("mg.consensus_round"):
+            with tracer.stage("mg.consensus_round"):
                 mr.propose(n_new, data=data)
             valid = mr.last_valid
             base = mr.last_base
@@ -673,7 +673,7 @@ class MultiGroupServer:
             ents = (to_persist or []) + [
                 Entry(index=self.seq, term=self.raft_term,
                       data=frontier)]
-            with tracer.span("mg.persist"):
+            with tracer.stage("mg.persist"):
                 self.wal.save(HardState(term=self.raft_term, vote=0,
                                         commit=self.seq), ents)
 
@@ -681,7 +681,7 @@ class MultiGroupServer:
             return
         n_apply = int((commit - self.applied)[newly].sum())
         t0 = time.perf_counter()
-        with tracer.span("mg.apply"):
+        with tracer.stage("mg.apply"):
             self._apply_newly(assigned, commit, newly)
         _M_APPLY_N.observe(n_apply)
         _M_APPLY_S.observe(time.perf_counter() - t0)

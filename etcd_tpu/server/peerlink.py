@@ -201,12 +201,19 @@ class PipeChannel:
     timeout) — the pipeline treats those as dropped frames and falls
     back to probe-and-resend, so at-least-once redelivery is the
     worst case, never silent loss.
+
+    ``on_sent(seq)`` (optional) fires on the writer thread right
+    after the frame's bytes hit the socket — the accurate send edge
+    the trace stitcher's clock alignment wants (the caller registers
+    the frame BEFORE queueing it, but the writer may drain later
+    under load; stamping at registration would fold queue wait into
+    the network hop).
     """
 
     def __init__(self, url: str, path: str, *, stripes: int = 1,
                  timeout: float = 1.0, read_timeout: float | None = None,
                  ssl_context=None, on_resp=None, on_fail=None,
-                 name: str = ""):
+                 on_sent=None, name: str = ""):
         self.url = url
         u = urlparse(url)
         self._host, self._port = u.hostname, u.port
@@ -220,6 +227,7 @@ class PipeChannel:
         self._ssl = ssl_context
         self._on_resp = on_resp or (lambda seq, status, body: None)
         self._on_fail = on_fail or (lambda seqs, reason: None)
+        self._on_sent = on_sent
         self._closed = threading.Event()
         self.stripes = max(1, stripes)
         self._stripes = [_Stripe() for _ in range(self.stripes)]
@@ -359,6 +367,9 @@ class PipeChannel:
                 sock.sendall(payload)
             except OSError:
                 self._teardown(st, "reconnect")
+                continue
+            if self._on_sent is not None:
+                self._on_sent(seq)
 
     def _reader(self, st: _Stripe) -> None:
         while not self._closed.is_set():

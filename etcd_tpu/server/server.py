@@ -252,7 +252,7 @@ class EtcdServer:
                 continue
 
             # persist BEFORE send (the Ready contract, node.go:41-60)
-            with tracer.span("server.persist"):
+            with tracer.stage("server.persist"):
                 self.storage.save(rd.hard_state, rd.entries)
                 self.storage.save_snap(rd.snapshot)
                 if not is_empty_snap(rd.snapshot):
@@ -268,10 +268,10 @@ class EtcdServer:
             for m in rd.messages:
                 if m.type == MSG_APP:
                     self.server_stats.send_append()
-            with tracer.span("server.send"):
+            with tracer.stage("server.send"):
                 self.send(rd.messages)
 
-            with tracer.span("server.apply"):
+            with tracer.stage("server.apply"):
                 for e in rd.committed_entries:
                     if e.type == ENTRY_NORMAL:
                         r = Request.unmarshal(e.data)

@@ -250,11 +250,15 @@ class ChunkPuller:
                  verifier: ChunkVerifier | None = None,
                  max_rejects: int = 8, deadline_s: float = 300.0,
                  stall_s: float = 20.0, abort=None,
-                 name: str = "snapstream"):
+                 on_reject=None, name: str = "snapstream"):
         from ..server.peerlink import PipeChannel
 
         self.meta = meta
         self._abort = abort or (lambda: False)
+        # fired per rejected chunk index: the receiving server's
+        # flight recorder rides this so chunk_reject outcomes reach
+        # its black box too (the metric alone is process-wide)
+        self._on_reject = on_reject or (lambda k: None)
         self.n = int(meta["n_chunks"])
         self.size = int(meta["size"])
         self.chunk_bytes = int(meta["chunk_bytes"])
@@ -392,6 +396,7 @@ class ChunkPuller:
                 if not okd:
                     # corrupt chunk: reject + refetch, NEVER install
                     _install_ctr("chunk_reject").inc()
+                    self._on_reject(j)
                     rejects += 1
                     log.warning(
                         "snapstream: chunk %d/%d failed rolling-CRC "

@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
 import time
 
 from ..obs import metrics as _metrics
@@ -34,6 +35,23 @@ _SPAN_FAMILY = "etcd_span_seconds"  # catalog family backing spans
 #: sliding window per span — governed by the catalog entry, surfaced
 #: here for readers of the old constant
 _WINDOW = _metrics.CATALOG[_SPAN_FAMILY].window
+
+#: thread-local stack of active _StageCtx instances: devledger
+#: charges device block/dispatch seconds to the INNERMOST stage so
+#: the wall/cpu/device columns of etcd_stage_seconds sum honestly
+#: (PR 8 — without this, a ledger-wrapped call inside a traced stage
+#: shows its window in both the span wall and the ledger counters
+#: with no way to separate them)
+_stage_tls = threading.local()
+
+
+def note_device_seconds(dt: float) -> None:
+    """Charge ``dt`` seconds of device dispatch/block time to the
+    innermost active stage() on this thread (no-op outside one).
+    Called by obs/devledger.py at its seam exits."""
+    stack = getattr(_stage_tls, "stack", None)
+    if stack:
+        stack[-1].device_s += dt
 
 
 class _Span:
@@ -52,6 +70,37 @@ class _Span:
         return False
 
 
+class _StageCtx:
+    """One pass through a labeled stage: wall + thread-CPU + device
+    attribution.  Also records the plain span (the ``/v2/stats/
+    spans`` surface keeps its coverage — byte-stable format, same
+    names)."""
+
+    __slots__ = ("tracer", "name", "t0", "c0", "device_s")
+
+    def __init__(self, tracer: "Tracer", name: str):
+        self.tracer = tracer
+        self.name = name
+
+    def __enter__(self):
+        self.device_s = 0.0
+        stack = getattr(_stage_tls, "stack", None)
+        if stack is None:
+            stack = _stage_tls.stack = []
+        stack.append(self)
+        self.t0 = time.perf_counter()
+        self.c0 = time.thread_time()
+        return self
+
+    def __exit__(self, *exc):
+        cpu = time.thread_time() - self.c0
+        wall = time.perf_counter() - self.t0
+        _stage_tls.stack.pop()
+        self.tracer.record(self.name, wall)
+        self.tracer.record_stage(self.name, wall, cpu, self.device_s)
+        return False
+
+
 class Tracer:
     """Span recorder over a metrics registry's span family.
 
@@ -67,9 +116,20 @@ class Tracer:
         # the histogram lock (catalog/label validation only on first
         # use) — the old deque implementation's cost profile
         self._hists: dict[str, _metrics.Histogram] = {}
+        # per-stage handle cache: (wall hist, cpu hist, device hist,
+        # spans counter) — record_stage runs per serving-loop pass
+        self._stages: dict[str, tuple] = {}
 
     def span(self, name: str) -> _Span:
         return _Span(self, name)
+
+    def stage(self, name: str) -> _StageCtx:
+        """Like :meth:`span`, plus per-stage CPU/device attribution:
+        the pass lands in ``etcd_stage_seconds{stage,kind}`` (wall |
+        cpu | device) and bumps ``etcd_trace_spans_total{stage}``.
+        The plain span family still gets the wall sample, so
+        ``/v2/stats/spans`` output is unchanged."""
+        return _StageCtx(self, name)
 
     def record(self, name: str, dt: float) -> None:
         h = self._hists.get(name)
@@ -77,6 +137,28 @@ class Tracer:
             h = self._hists[name] = self._reg.histogram(
                 "etcd_span_seconds", span=name)
         h.observe(dt)
+
+    def record_stage(self, name: str, wall: float, cpu: float,
+                     device: float = 0.0) -> None:
+        handles = self._stages.get(name)
+        if handles is None:
+            handles = self._stages[name] = (
+                self._reg.histogram("etcd_stage_seconds",
+                                    stage=name, kind="wall"),
+                self._reg.histogram("etcd_stage_seconds",
+                                    stage=name, kind="cpu"),
+                self._reg.histogram("etcd_stage_seconds",
+                                    stage=name, kind="device"),
+                self._reg.counter("etcd_trace_spans_total",
+                                  stage=name))
+        handles[0].observe(wall)
+        handles[1].observe(cpu)
+        if device > 0.0:
+            # device samples only when the stage actually crossed a
+            # ledger seam — an all-zero series would drown the sums'
+            # signal in sample count without adding information
+            handles[2].observe(device)
+        handles[3].inc()
 
     def snapshot(self) -> dict:
         out = {}
@@ -102,11 +184,17 @@ class Tracer:
                 "\n").encode()
 
     def reset(self) -> None:
-        # the cache must drop with the family's children: a cached
+        # the caches must drop with the families' children: a cached
         # handle to a cleared child would record into an orphan the
         # snapshot path no longer sees
         self._hists = {}
+        self._stages = {}
         self._reg.family(_SPAN_FAMILY).clear()
+        for fam in ("etcd_stage_seconds", "etcd_trace_spans_total"):
+            try:
+                self._reg.family(fam).clear()
+            except KeyError:  # pragma: no cover - custom catalogs
+                pass
 
 
 #: process-wide default tracer — servers and replay paths record here

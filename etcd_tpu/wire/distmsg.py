@@ -57,6 +57,22 @@ KIND_VOTE = 2
 KIND_VOTE_RESP = 3
 KIND_PROPOSE = 4
 
+# Header flag bits.  FLAG_TRACE (PR 8): the frame carries an
+# OPTIONAL trace block AFTER the payload table — (group, gindex,
+# trace_id, origin) per head-sampled entry, the distributed-trace
+# context followers stamp their span events with.  Versioning is
+# structural: an old peer ignores unknown flag bits and never reads
+# past the sections it knows (trailing bytes are ignored), so a
+# traced frame parses on old peers exactly as an untraced one; an
+# untraced frame (flags=0) is BYTE-IDENTICAL to the pre-trace
+# layout, so new peers interop with old senders for free.
+FLAG_TRACE = 0x0001
+
+#: one trace entry: group i32, gindex i32, trace_id u32, origin u8
+#: (+3 pad — keeps entries 16-byte and the block 4-aligned)
+_TRACE_ENT = struct.Struct("<iiIBxxx")
+_TRACE_MAX = 65536  # sanity bound: sampled entries, never the batch
+
 
 class FrameError(Exception):
     pass
@@ -97,16 +113,48 @@ def _w_u8(buf: bytearray, pos: int, arr) -> int:
     return pos + n
 
 
-def parse_header(data) -> tuple[int, int, int, int, int, int]:
-    """Returns (kind, sender_slot, g, e, seq, epoch); raises
+def parse_header(data) -> tuple[int, int, int, int, int, int, int]:
+    """Returns (kind, sender_slot, g, e, seq, epoch, flags); raises
     FrameError."""
     if len(data) < _HDR.size:
         raise FrameError("short frame")
-    magic, kind, sender, _flags, g, e, seq, epoch = \
+    magic, kind, sender, flags, g, e, seq, epoch = \
         _HDR.unpack_from(data)
     if magic != _MAGIC:
         raise FrameError("bad magic")
-    return kind, sender, g, e, seq, epoch
+    return kind, sender, g, e, seq, epoch, flags
+
+
+def _read_trace(data, pos: int) -> list[tuple[int, int, int, int]]:
+    """Parse the optional trailing trace block at ``pos`` (the
+    FLAG_TRACE bit was set).  Raises FrameError on truncation or an
+    implausible count — a flipped flag bit must fail typed, never
+    escape as IndexError/struct.error."""
+    if pos + 4 > len(data):
+        raise FrameError("truncated trace block")
+    (n,) = struct.unpack_from("<I", data, pos)
+    pos += 4
+    if n > _TRACE_MAX:
+        raise FrameError(f"implausible trace count {n}")
+    end = pos + n * _TRACE_ENT.size
+    if end > len(data):
+        raise FrameError("truncated trace block")
+    out = []
+    for _ in range(n):
+        g, gi, tid, org = _TRACE_ENT.unpack_from(data, pos)
+        out.append((g, gi, tid, org))
+        pos += _TRACE_ENT.size
+    return out
+
+
+def _write_trace(buf: bytearray, pos: int, trace) -> int:
+    struct.pack_into("<I", buf, pos, len(trace))
+    pos += 4
+    for g, gi, tid, org in trace:
+        _TRACE_ENT.pack_into(buf, pos, g, gi, tid & 0xFFFFFFFF,
+                             org & 0xFF)
+        pos += _TRACE_ENT.size
+    return pos
 
 
 @dataclass
@@ -136,6 +184,10 @@ class AppendBatch:
     payloads: list[list[bytes]] = field(default_factory=list)
     seq: int = 0
     epoch: int = 0
+    #: optional distributed-trace block (PR 8): (group, gindex,
+    #: trace_id, origin) per head-sampled entry this frame carries.
+    #: None/[] marshals the exact pre-trace layout (flags=0).
+    trace: list[tuple[int, int, int, int]] | None = None
 
     def marshal(self) -> bytearray:
         g = self.term.shape[0]
@@ -149,10 +201,13 @@ class AppendBatch:
                 ln = len(row[j]) if j < len(row) else 0
                 lens.append(ln)
                 blob_total += ln
+        trace = self.trace or None
+        flags = FLAG_TRACE if trace else 0
+        tr_bytes = (4 + _TRACE_ENT.size * len(trace)) if trace else 0
         out = bytearray(_HDR.size + (5 * g + g * e + len(lens)) * 4
-                        + 2 * g + blob_total)
-        _HDR.pack_into(out, 0, _MAGIC, KIND_APPEND, self.sender, 0,
-                       g, e, self.seq & 0xFFFFFFFF,
+                        + 2 * g + blob_total + tr_bytes)
+        _HDR.pack_into(out, 0, _MAGIC, KIND_APPEND, self.sender,
+                       flags, g, e, self.seq & 0xFFFFFFFF,
                        self.epoch & 0xFFFFFFFF)
         pos = _HDR.size
         pos = _w_i32(out, pos, self.term)
@@ -170,11 +225,13 @@ class AppendBatch:
                 b = row[j] if j < len(row) else b""
                 out[pos:pos + len(b)] = b
                 pos += len(b)
+        if trace:
+            pos = _write_trace(out, pos, trace)
         return out
 
     @classmethod
     def unmarshal(cls, data) -> "AppendBatch":
-        kind, sender, g, e, seq, epoch = parse_header(data)
+        kind, sender, g, e, seq, epoch, flags = parse_header(data)
         if kind != KIND_APPEND:
             raise FrameError(f"kind {kind} != append")
         pos = _HDR.size
@@ -207,12 +264,14 @@ class AppendBatch:
                 row.append(bytes(buf[pos:pos + ln]))
                 pos += ln
             payloads.append(row)
+        trace = (_read_trace(data, pos) if flags & FLAG_TRACE
+                 else None)
         return cls(sender=sender, term=term, prev_idx=prev_idx,
                    prev_term=prev_term, n_ents=n_ents, commit=commit,
                    active=active.astype(bool),
                    need_snap=need_snap.astype(bool),
                    ent_terms=ets.reshape(g, e), payloads=payloads,
-                   seq=seq, epoch=epoch)
+                   seq=seq, epoch=epoch, trace=trace)
 
 
 @dataclass
@@ -257,7 +316,7 @@ class AppendResp:
 
     @classmethod
     def unmarshal(cls, data) -> "AppendResp":
-        kind, sender, g, _e, seq, epoch = parse_header(data)
+        kind, sender, g, _e, seq, epoch, _flags = parse_header(data)
         if kind != KIND_APPEND_RESP:
             raise FrameError(f"kind {kind} != append_resp")
         pos = _HDR.size
@@ -295,7 +354,7 @@ class VoteReq:
 
     @classmethod
     def unmarshal(cls, data) -> "VoteReq":
-        kind, sender, g, _e, _seq, _epoch = parse_header(data)
+        kind, sender, g, _e, _seq, _epoch, _fl = parse_header(data)
         if kind != KIND_VOTE:
             raise FrameError(f"kind {kind} != vote")
         pos = _HDR.size
@@ -329,7 +388,7 @@ class VoteResp:
 
     @classmethod
     def unmarshal(cls, data) -> "VoteResp":
-        kind, sender, g, _e, _seq, _epoch = parse_header(data)
+        kind, sender, g, _e, _seq, _epoch, _fl = parse_header(data)
         if kind != KIND_VOTE_RESP:
             raise FrameError(f"kind {kind} != vote_resp")
         pos = _HDR.size

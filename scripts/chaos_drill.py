@@ -175,6 +175,40 @@ def fetch_obs(slot, timeout=5):
         return json.loads(r.read())
 
 
+def harvest_flight(tag):
+    """Pull every node's flight ring (GET /mraft/obs/flight) into a
+    timestamped artifact dir — runs on ANY gate failure, so the
+    post-mortem starts from the servers' own black boxes instead of
+    whatever stdout happened to capture (PR 8).  A node that died
+    before the harvest left its SIGTERM/crash dump under its data
+    dir; the summary points there."""
+    from etcd_tpu.obs.flight import harvest_rings
+
+    ts = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    art = os.path.join(REPO, "trace_artifacts", f"chaos_{tag}_{ts}")
+    paths = harvest_rings(PEERS, art, timeout=5)
+    if len(paths) < 3:
+        print(f"flight harvest: {3 - len(paths)} node(s) "
+              f"unreachable — their SIGTERM/crash dumps, if any, "
+              f"are under {BASE}/d*/trace_artifacts/", flush=True)
+    print("GATE FAILURE FORENSICS — flight dumps harvested "
+          f"({len(paths)}/3 nodes):", flush=True)
+    for p in paths:
+        print(f"  {p}", flush=True)
+    print(f"  stitch with: python scripts/trace_stitch.py {art}",
+          flush=True)
+    return paths
+
+
+def forced_gate_fail():
+    """Test hook: CHAOS_FORCE_GATE_FAIL=1 trips an artificial gate
+    failure right after settle — proves the harvest-on-failure path
+    end to end without waiting for a real (rare) gate trip."""
+    if os.environ.get("CHAOS_FORCE_GATE_FAIL"):
+        raise AssertionError(
+            "forced gate failure (CHAOS_FORCE_GATE_FAIL)")
+
+
 def obs_counter(snap, family, **labels):
     total = 0.0
     for s in snap.get(family, {}).get("samples", []):
@@ -234,6 +268,7 @@ def deep_lag_drill(lag_writes: int) -> None:
                         raise RuntimeError("cluster failed to settle")
                     time.sleep(0.5)
         print("deep-lag: settled", flush=True)
+        forced_gate_fail()
 
         victim = 2
         survivors = [0, 1]
@@ -378,6 +413,11 @@ def deep_lag_drill(lag_writes: int) -> None:
               f"member, streamed install with corrupt-chunk "
               f"rejection, catch-up {catchup_s:.1f}s, "
               f"zero lost writes", flush=True)
+    except (AssertionError, RuntimeError):
+        # ANY gate failure: harvest every node's black box BEFORE
+        # the finally kills them — no more stdout-only forensics
+        harvest_flight("deeplag")
+        raise
     finally:
         for p in procs.values():
             try:
@@ -503,6 +543,7 @@ def linz_drill(cycles: int) -> None:
                         raise RuntimeError("cluster failed to settle")
                     time.sleep(0.5)
         print("linz: settled", flush=True)
+        forced_gate_fail()
         threads = [threading.Thread(target=client_loop, args=(t,),
                                     daemon=True)
                    for t in range(N_CLIENTS)]
@@ -563,6 +604,10 @@ def linz_drill(cycles: int) -> None:
               f"{stats['reads_ok'] + stats['burst_ok']} reads "
               f"served, {stats['reads_rejected']} rejected "
               f"(fail-closed), ZERO stale reads", flush=True)
+    except (AssertionError, RuntimeError):
+        stop.set()
+        harvest_flight("linz")
+        raise
     finally:
         stop.set()
         for p in procs.values():
@@ -644,6 +689,7 @@ try:
                         "cluster failed to settle in 60s")
                 time.sleep(0.5)
     print("cluster settled: all groups serving", flush=True)
+    forced_gate_fail()
 
     for cycle in range(CYCLES):
         victim = rng.randrange(3)
@@ -960,6 +1006,11 @@ try:
             f"p99 server kill->writable {w99:.2f}s >= {wb99}s"
     print(f"CHAOS DRILL CLEAN: {CYCLES} kill/restart cycles, "
           f"{seq} writes, zero acked writes lost", flush=True)
+except (AssertionError, RuntimeError):
+    # harvest every node's flight ring before teardown — the gate
+    # post-mortem reads the black boxes, not scrollback
+    harvest_flight("plain")
+    raise
 finally:
     for p in procs.values():
         try:

@@ -227,6 +227,52 @@ def fetch_read_stats(urls, timeout=5):
     return out
 
 
+def fetch_stage_stats(urls, timeout=5):
+    """Per-stage wall/CPU/device attribution off /mraft/obs (the
+    etcd_stage_seconds families the stage() facade feeds, PR 8):
+    the honest CPU budget table a dist_bench row carries for
+    ROADMAP open item 2 — which stage is eating the serving core."""
+    agg: dict[str, dict[str, float]] = {}
+    for u in urls:
+        try:
+            with urllib.request.urlopen(u + "/mraft/obs",
+                                        timeout=timeout) as r:
+                snap = json.loads(r.read())
+        except Exception:
+            continue
+        for s in snap.get("etcd_stage_seconds",
+                          {}).get("samples", []):
+            stage = s["labels"].get("stage", "?")
+            kind = s["labels"].get("kind", "?")
+            row = agg.setdefault(stage, {"wall_s": 0.0, "cpu_s": 0.0,
+                                         "device_s": 0.0,
+                                         "passes": 0})
+            if kind == "wall":
+                row["wall_s"] += s.get("sum", 0.0)
+                row["passes"] += s.get("count", 0)
+            elif kind == "cpu":
+                row["cpu_s"] += s.get("sum", 0.0)
+            elif kind == "device":
+                row["device_s"] += s.get("sum", 0.0)
+    out = {}
+    for stage, row in sorted(agg.items(),
+                             key=lambda kv: -kv[1]["cpu_s"]):
+        out[stage] = {"wall_s": round(row["wall_s"], 3),
+                      "cpu_s": round(row["cpu_s"], 3),
+                      "device_s": round(row["device_s"], 3),
+                      "passes": int(row["passes"])}
+    return out
+
+
+def harvest_flight(urls, out_dir, timeout=10):
+    """Pull every node's flight ring into ``out_dir`` for the
+    offline stitcher (the shared obs.flight.harvest_rings loop);
+    returns the dump paths."""
+    from etcd_tpu.obs.flight import harvest_rings
+
+    return harvest_rings(urls, out_dir, timeout=timeout)
+
+
 def free_ports(n):
     socks, ports = [], []
     for _ in range(n):
@@ -245,10 +291,12 @@ CAP = int(os.environ.get("DIST_CAP", 1024))  # per-group log window
 SNAP_COUNT = int(os.environ.get("DIST_SNAP_COUNT", 0))
 
 
-def spawn(tmp, slot, urls, depth=8, extra=()):
+def spawn(tmp, slot, urls, depth=8, extra=(), env_extra=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
+    if env_extra:
+        env.update(env_extra)
     cmd = [sys.executable,
            os.path.join(REPO, "scripts", "dist_node.py"),
            "--data-dir", os.path.join(tmp, f"d{slot}"),
@@ -296,14 +344,18 @@ def wait_ready(proc, timeout=180):
 
 
 def run_once(total: int, conns: int, window: int,
-             depth: int = 8) -> dict:
+             depth: int = 8, trace_sample: int | None = None,
+             flight_dir: str | None = None) -> dict:
     import resource
 
     cpu0 = resource.getrusage(resource.RUSAGE_CHILDREN)
     ports = free_ports(3)
     urls = [f"http://127.0.0.1:{p}" for p in ports]
     tmp = tempfile.mkdtemp()
-    procs = [spawn(tmp, s, urls, depth=depth) for s in range(3)]
+    env_extra = (None if trace_sample is None
+                 else {"ETCD_TRACE_SAMPLE": str(trace_sample)})
+    procs = [spawn(tmp, s, urls, depth=depth, env_extra=env_extra)
+             for s in range(3)]
     acked = [0] * conns
     try:
         for p in procs:
@@ -382,6 +434,13 @@ def run_once(total: int, conns: int, window: int,
         rtt = fetch_ack_rtt(urls) or {}
         rtt.update(fetch_pipe_stats(urls))
         rtt.update(disk_usage(tmp))
+        # the per-stage wall/CPU/device budget (PR 8): every row
+        # carries WHERE the cluster's core went, not just the rates
+        rtt["stage_seconds"] = fetch_stage_stats(urls)
+        if trace_sample is not None:
+            rtt["trace_sample"] = trace_sample
+        if flight_dir:
+            rtt["flight_dumps"] = harvest_flight(urls, flight_dir)
         if SNAP_COUNT:
             rtt["snap_count"] = SNAP_COUNT
         row = {
@@ -578,6 +637,7 @@ def run_read_mix(total: int, conns: int, window: int,
             t.join()
         stats = fetch_read_stats(urls)
         stats.update(disk_usage(tmp))
+        stats["stage_seconds"] = fetch_stage_stats(urls)
         row = {
             "bench": "dist_read_mix",
             "hosts": 3, "groups": G,
@@ -636,6 +696,54 @@ def check_read_mix(row: dict) -> None:
     assert row["read_index_batch_p50"] > 1, (
         f"ReadIndex batch p50 {row['read_index_batch_p50']} <= 1 — "
         f"confirmation is running per-read rounds")
+
+
+def run_trace_overhead(total: int, conns: int, window: int, *,
+                       depth: int, sample: int,
+                       check: bool) -> dict:
+    """The tracing-overhead figure (PR 8 satellite): the SAME
+    workload with head-sampled tracing on (1-in-``sample``) and
+    fully off (``ETCD_TRACE_SAMPLE=0``), acked/s compared.  The
+    ``--check`` gate holds the overhead at <= 3% — the budget that
+    keeps the default-on sampling honest.
+
+    Each arm runs TWICE, interleaved (on/off/on/off), and the arm's
+    figure is its best run: on this 1-core shared harness the
+    run-to-run jitter of a fresh 3-process cluster (~3-5%) exceeds
+    the effect being measured, and the max is the least-contended
+    estimate of each arm's capacity — a single-run comparison reads
+    scheduler noise as overhead as often as it reads overhead."""
+    traced_rows, off_rows = [], []
+    for _ in range(2):
+        traced_rows.append(run_once(total, conns, window,
+                                    depth=depth,
+                                    trace_sample=sample))
+        print(json.dumps(traced_rows[-1]), flush=True)
+        off_rows.append(run_once(total, conns, window, depth=depth,
+                                 trace_sample=0))
+        print(json.dumps(off_rows[-1]), flush=True)
+    traced_pps = max(r["proposals_per_sec"] for r in traced_rows)
+    off_pps = max(r["proposals_per_sec"] for r in off_rows) or 1.0
+    overhead = max(0.0, 100.0 * (off_pps - traced_pps) / off_pps)
+    row = {
+        "bench": "dist_trace_overhead",
+        "proposals": total, "conns": conns, "window": window,
+        "pipeline_depth": depth, "trace_sample": sample,
+        "runs_per_arm": 2, "estimator": "best-of-arm",
+        "traced_pps": traced_pps,
+        "untraced_pps": off_pps,
+        "traced_runs": [r["proposals_per_sec"]
+                        for r in traced_rows],
+        "untraced_runs": [r["proposals_per_sec"]
+                          for r in off_rows],
+        "trace_overhead_pct": round(overhead, 2),
+    }
+    print(json.dumps(row), flush=True)
+    if check:
+        assert overhead <= 3.0, (
+            f"tracing overhead {overhead:.2f}% > 3% acked/s "
+            f"(traced {traced_pps}/s vs untraced {off_pps}/s)")
+    return row
 
 
 SWEEP_DEPTHS = (1, 2, 4, 8, 16)
@@ -710,11 +818,20 @@ def main() -> None:
                     help="with --read-mix: the nodes' "
                          "--lease-ticks (0 = lease off, every "
                          "linearizable read takes ReadIndex)")
+    ap.add_argument("--trace-overhead", action="store_true",
+                    help="measure acked/s with head-sampled tracing "
+                         "on vs ETCD_TRACE_SAMPLE=0 (PR 8); with "
+                         "--check asserts overhead <= 3%%")
+    ap.add_argument("--trace-sample", type=int, default=64,
+                    help="head-sampling rate for --trace-overhead's "
+                         "traced run (1-in-N; default 64, the "
+                         "server default)")
     ap.add_argument("--check", action="store_true",
                     help="with --sweep: assert the >=4x ack-p50 "
                          "gate; with --read-mix: assert the PR-7 "
                          "gate (reads/s >= 50x acked-writes/s, "
-                         "lease dominant, batch p50 > 1)")
+                         "lease dominant, batch p50 > 1); with "
+                         "--trace-overhead: assert the <=3%% gate")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny loopback run for scripts/test: "
                          "depth 1 vs 8, sanity-only assertions")
@@ -729,10 +846,29 @@ def main() -> None:
         # acks every proposal, and depth=1 still works (the
         # lockstep-equivalent window); the 4x gate needs the full
         # sweep's sample sizes, not a smoke run
-        for depth in (1, 8):
-            row = run_once(800, 4, 100, depth=depth)
+        row = run_once(800, 4, 100, depth=1)
+        print(json.dumps(row), flush=True)
+        assert row["acked"] == 800, row
+        # the depth-8 leg doubles as the tracing acceptance run
+        # (PR 8): 1-in-4 head sampling over 800 writes, flight
+        # rings harvested and stitched offline — >= 100 COMPLETE
+        # per-proposal timelines (every stage ingest->client-ack
+        # plus a follower hop) must reconstruct, with the stage
+        # breakdown printed
+        import trace_stitch
+
+        with tempfile.TemporaryDirectory() as td:
+            row = run_once(800, 4, 100, depth=8, trace_sample=4,
+                           flight_dir=td)
             print(json.dumps(row), flush=True)
             assert row["acked"] == 800, row
+            assert row["stage_seconds"], \
+                "no etcd_stage_seconds samples on /mraft/obs"
+            rep = trace_stitch.stitch_dir(td)
+            trace_stitch.print_report(rep)
+            assert rep["complete"] >= 100, (
+                f"only {rep['complete']} complete proposal "
+                f"timelines stitched (need >= 100)")
         # read path (PR 7): every batched linearizable GET must
         # serve, off the zero-WAL lane, with reads outrunning the
         # concurrent writes; the 50x gate needs the full run's
@@ -759,6 +895,18 @@ def main() -> None:
                 json.dump(row, f, indent=1, sort_keys=True)
         if args.check:
             check_read_mix(row)
+        return
+    if args.trace_overhead:
+        row = run_trace_overhead(
+            args.total, args.conns, args.window, depth=args.depth,
+            sample=args.trace_sample, check=args.check)
+        if args.out_dir:
+            os.makedirs(args.out_dir, exist_ok=True)
+            ts = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+            with open(os.path.join(
+                    args.out_dir,
+                    f"dist_trace_overhead_{ts}.json"), "w") as f:
+                json.dump(row, f, indent=1, sort_keys=True)
         return
     if args.sweep:
         run_sweep(args.total, args.conns, args.window,

@@ -67,6 +67,17 @@ def main() -> None:
                      lease_ticks=args.lease_ticks)
     srv.start()
 
+    # black-box dump on the way down (PR 8): SIGTERM (the bench's
+    # teardown signal) or a crash writes the flight ring to
+    # ETCD_FLIGHT_DIR (default: alongside the data dir) — forensics
+    # survive the process
+    from etcd_tpu.obs.flight import install_crash_dump
+
+    install_crash_dump(srv.flight,
+                       os.environ.get("ETCD_FLIGHT_DIR")
+                       or os.path.join(args.data_dir,
+                                       "trace_artifacts"))
+
     # SIGUSR1 dumps the tracer span table to stdout (profiling a real
     # cluster process from outside without stopping it)
     import signal as _signal

@@ -115,6 +115,13 @@ def _dist_cases(rng):
     n_ents = np.asarray([rng.randrange(e + 1) for _ in range(g)],
                         np.int32)
     payloads = [[_bytes(rng) for _ in range(int(n))] for n in n_ents]
+    # optional trace block (PR 8): absent (the pre-trace layout,
+    # must parse as today) or a few sampled entries that round-trip
+    trace = None
+    if rng.random() < 0.5:
+        trace = [(rng.randrange(g), rng.randrange(1 << 20),
+                  rng.randrange(1 << 32), rng.randrange(8))
+                 for _ in range(rng.randrange(1, 4))]
     yield AppendBatch(
         sender=rng.randrange(4), term=i32(), prev_idx=i32(),
         prev_term=i32(), n_ents=n_ents, commit=i32(), active=mask(),
@@ -122,7 +129,7 @@ def _dist_cases(rng):
         ent_terms=np.asarray(
             [[rng.randrange(1 << 20) for _ in range(e)]
              for _ in range(g)], np.int32),
-        payloads=payloads, seq=seq, epoch=epoch)
+        payloads=payloads, seq=seq, epoch=epoch, trace=trace)
     yield AppendResp(sender=rng.randrange(4), term=i32(), ok=mask(),
                      acked=i32(), hint=i32(), active=mask(),
                      seq=seq, epoch=epoch)
