@@ -36,27 +36,28 @@ class Client:
 
     # -- http --------------------------------------------------------------
 
-    def _do(self, method: str, path: str, params: dict | None = None,
-            form: dict | None = None, timeout: float | None = None):
+    def _request(self, method: str, path: str,
+                 params: dict | None = None,
+                 data: bytes | None = None,
+                 content_type: str | None = None,
+                 timeout: float | None = None):
+        """One request attempt per endpoint until one connects: the
+        single copy of the failover + error-vocabulary policy.
+        Returns the OPEN response (caller reads or streams it);
+        HTTP errors surface as ClientError, dead endpoints are
+        skipped."""
         last_err: Exception = ClientError(0, "no endpoints tried")
         for ep in self.endpoints:
-            url = ep + "/v2/keys" + path
+            url = ep + path
             if params:
                 url += "?" + urllib.parse.urlencode(params)
-            data = urllib.parse.urlencode(form).encode() if form else None
             req = urllib.request.Request(url, data=data, method=method)
-            if data:
-                req.add_header("Content-Type",
-                               "application/x-www-form-urlencoded")
+            if content_type:
+                req.add_header("Content-Type", content_type)
             try:
-                with urllib.request.urlopen(
-                        req, timeout=timeout or self.timeout,
-                        context=self._ssl) as resp:
-                    body = resp.read().decode()
-                    out = json.loads(body) if body.strip() else {}
-                    out["etcdIndex"] = int(
-                        resp.headers.get("X-Etcd-Index") or 0)
-                    return out
+                return urllib.request.urlopen(
+                    req, timeout=timeout or self.timeout,
+                    context=self._ssl)
             except urllib.error.HTTPError as e:
                 body = e.read().decode()
                 try:
@@ -68,6 +69,19 @@ class Client:
                 last_err = e
                 continue
         raise last_err
+
+    def _do(self, method: str, path: str, params: dict | None = None,
+            form: dict | None = None, timeout: float | None = None):
+        data = urllib.parse.urlencode(form).encode() if form else None
+        with self._request(
+                method, "/v2/keys" + path, params, data,
+                "application/x-www-form-urlencoded" if data else None,
+                timeout) as resp:
+            body = resp.read().decode()
+            out = json.loads(body) if body.strip() else {}
+            out["etcdIndex"] = int(
+                resp.headers.get("X-Etcd-Index") or 0)
+            return out
 
     # -- actions (reference client/http.go:184-247) ------------------------
 
@@ -122,3 +136,38 @@ class Client:
             params["recursive"] = "true"
         return self._do("GET", key, params=params,
                         timeout=timeout or 330.0)
+
+    def watch_stream(self, key: str, wait_index: int | None = None,
+                     recursive: bool = False,
+                     timeout: float | None = None):
+        """Streaming watch generator (?wait=true&stream=true, PR 9):
+        yields one event dict per change on a single chunked
+        connection; blank keepalive lines are skipped.  Iteration
+        ends when the server closes the stream (watch timeout or
+        watcher eviction)."""
+        params = {"wait": "true", "stream": "true"}
+        if wait_index is not None:
+            params["waitIndex"] = str(wait_index)
+        if recursive:
+            params["recursive"] = "true"
+        with self._request("GET", "/v2/keys" + key, params=params,
+                           timeout=timeout or 330.0) as resp:
+            for line in resp:
+                if line.strip():
+                    yield json.loads(line)
+
+    def watch_many(self, specs: list[dict],
+                   timeout: float | None = None):
+        """Batched multiplexed watch (POST /v2/watch, PR 9): register
+        every spec (``{"key", "recursive", "stream", "since"}``) in
+        one request and yield ``{"watch": <spec idx>, ...event}``
+        lines off one chunked stream.  ``{"watch": i, "closed":
+        true}`` marks a member evicted or fired one-shot; the stream
+        ends when every member has closed."""
+        with self._request("POST", "/v2/watch",
+                           data=json.dumps(specs).encode(),
+                           content_type="application/json",
+                           timeout=timeout or 330.0) as resp:
+            for line in resp:
+                if line.strip():
+                    yield json.loads(line)

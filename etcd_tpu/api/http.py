@@ -35,6 +35,7 @@ from ..wire.requests import Request
 log = logging.getLogger(__name__)
 
 KEYS_PREFIX = "/v2/keys"
+WATCH_PREFIX = "/v2/watch"
 MACHINES_PREFIX = "/v2/machines"
 STATS_PREFIX = "/v2/stats"
 METRICS_PREFIX = "/metrics"
@@ -42,6 +43,16 @@ RAFT_PREFIX = "/raft"
 
 DEFAULT_SERVER_TIMEOUT = 0.5  # reference http.go:29
 DEFAULT_WATCH_TIMEOUT = 300.0  # reference http.go:32
+# blank-line chunk cadence on idle streaming watches so proxies and
+# client read timeouts don't tear down a healthy stream (PR 9)
+DEFAULT_WATCH_KEEPALIVE = 25.0
+# /v2/watch batched registration cap: one request may register this
+# many watches over one multiplexed stream
+WATCH_BATCH_MAX = 200_000
+# specs registered per hub-lock take on /v2/watch; history catch-up
+# drains to the wire between chunks so the mux never has to hold a
+# whole reconnect storm's replay
+WATCH_REG_CHUNK = 512
 
 
 def parse_request(method: str, path: str, form: dict[str, list[str]],
@@ -152,6 +163,7 @@ class EtcdRequestHandler(BaseHTTPRequestHandler):
     cors: set[str] | None = None
     server_timeout = DEFAULT_SERVER_TIMEOUT
     watch_timeout = DEFAULT_WATCH_TIMEOUT
+    watch_keepalive = DEFAULT_WATCH_KEEPALIVE
 
     def log_message(self, fmt, *args):  # quiet by default
         log.debug("http: " + fmt, *args)
@@ -238,7 +250,9 @@ class EtcdRequestHandler(BaseHTTPRequestHandler):
                 else:
                     self._reply(404, b"404 page not found\n")
                 return
-            if path == MACHINES_PREFIX:
+            if path == WATCH_PREFIX:
+                self._serve_watch_many(method)
+            elif path == MACHINES_PREFIX:
                 self._serve_machines(method)
             elif path == METRICS_PREFIX:
                 self._serve_metrics(method)
@@ -308,6 +322,20 @@ class EtcdRequestHandler(BaseHTTPRequestHandler):
                 urllib.parse.unquote(
                     urllib.parse.urlsplit(self.path).path),
                 form, gen_id())
+            # per-request keepalive override for streaming watches:
+            # ?keepalive=SECONDS (0 disables) — the escape hatch for
+            # clients that JSON-parse every line and can't skip the
+            # blank keepalive chunks
+            keepalive = self.watch_keepalive
+            if "keepalive" in form:
+                try:
+                    keepalive = float(form["keepalive"][0])
+                    if keepalive < 0:
+                        raise ValueError
+                except ValueError:
+                    raise EtcdError(ECODE_INVALID_FIELD,
+                                    'invalid value for "keepalive"') \
+                        from None
         except EtcdError as e:
             self._write_error(e)
             return
@@ -326,9 +354,169 @@ class EtcdRequestHandler(BaseHTTPRequestHandler):
         if resp.event is not None:
             self._write_event(resp.event)
         elif resp.watcher is not None:
-            self._handle_watch(resp.watcher, rr.stream)
+            self._handle_watch(resp.watcher, rr.stream, keepalive)
         else:  # pragma: no cover
             self._write_error(RuntimeError("no event/watcher"))
+
+    def _serve_watch_many(self, method: str) -> None:
+        """POST /v2/watch — batched watch registration + ONE
+        multiplexed chunked stream (PR 9; no reference counterpart —
+        100k discovery watches must not cost 100k hub-lock round
+        trips and 100k connections).
+
+        Body: JSON array of ``{"key", "recursive", "stream",
+        "since"}`` specs (stream defaults true).  The reply streams
+        JSON lines tagged with the spec position: ``{"watch": i,
+        ...event}``, ``{"watch": i, "closed": true}`` when a member
+        was evicted or fired one-shot, ``{"watch": i, "error":
+        {...}}`` for a spec a compacted history rejected; blank lines
+        are keepalives."""
+        if method != "POST":
+            self._reply(405, b"Method Not Allowed\n", {"Allow": "POST"})
+            return
+        length = int(self.headers.get("Content-Length") or 0)
+        try:
+            doc = json.loads(self.rfile.read(length) or b"[]")
+            if not isinstance(doc, list) or len(doc) > WATCH_BATCH_MAX:
+                raise ValueError("bad batch")
+            specs = [(str(d.get("key", "/")),
+                      bool(d.get("recursive", False)),
+                      bool(d.get("stream", True)),
+                      int(d.get("since", 0)))
+                     for d in doc]
+        except (ValueError, TypeError, AttributeError,
+                json.JSONDecodeError):
+            self._write_error(EtcdError(
+                ECODE_INVALID_FORM,
+                "watch batch must be a JSON array of watch specs "
+                f"(max {WATCH_BATCH_MAX})"))
+            return
+
+        from ..store.fanout import WatchMux
+
+        mux = WatchMux(capacity=max(4096, 2 * WATCH_REG_CHUNK))
+        watchers: list = []
+        open_members = 0
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("X-Etcd-Index",
+                             str(self.etcd.store.index()))
+            self.send_header("Transfer-Encoding", "chunked")
+            self._cors_headers()
+            self.end_headers()
+            self.wfile.flush()
+
+            def flush_mux() -> int:
+                """Write everything queued; returns members closed."""
+                closed = 0
+                while True:
+                    item = mux.pop(timeout=0)
+                    if item is None:
+                        return closed
+                    mid, ev = item
+                    if ev is None:
+                        line = {"watch": mid, "closed": True}
+                        closed += 1
+                    else:
+                        line = {"watch": mid}
+                        line.update(ev.to_dict())
+                    self._write_chunk((json.dumps(line)
+                                       + "\n").encode())
+
+            # register in chunks (bounded hub-lock takes), then stream
+            # each lagging member's history catch-up STRAIGHT to the
+            # wire: a member can lag a whole history window, and
+            # buffering any batch's replay in the bounded mux would
+            # evict it during registration — the mux carries only
+            # live events (dispatched past each member's advanced
+            # since-index), replay reads the history ring outside
+            # every lock at the connection's own pace
+            for base in range(0, len(specs), WATCH_REG_CHUNK):
+                ws = self.etcd.store.watch_many(
+                    specs[base:base + WATCH_REG_CHUNK], mux=mux,
+                    mid_base=base)
+                watchers.extend(ws)
+                for i, w in enumerate(ws, start=base):
+                    if isinstance(w, EtcdError):
+                        self._write_chunk((json.dumps(
+                            {"watch": i,
+                             "error": json.loads(w.to_json())})
+                            + "\n").encode())
+                    else:
+                        open_members += 1
+                for j, w in enumerate(ws):
+                    if getattr(w, "replay", None) is not None:
+                        self._replay_member(w, base + j,
+                                            specs[base + j])
+                open_members -= flush_mux()
+
+            deadline = time.monotonic() + self.watch_timeout
+            last_write = time.monotonic()
+            while open_members > 0:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    break
+                item = mux.pop(timeout=min(remain, 1.0))
+                if item is None:
+                    if self.watch_keepalive and \
+                            (time.monotonic() - last_write
+                             >= self.watch_keepalive):
+                        self._write_chunk(b"\n")
+                        last_write = time.monotonic()
+                    continue
+                mid, ev = item
+                if ev is None:
+                    # member closed (evicted or fired one-shot); the
+                    # stream ends once every member has
+                    line = {"watch": mid, "closed": True}
+                    open_members -= 1
+                else:
+                    line = {"watch": mid}
+                    line.update(ev.to_dict())
+                self._write_chunk((json.dumps(line) + "\n").encode())
+                last_write = time.monotonic()
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            # close the mux FIRST so the batched removal's member
+            # closes become no-ops instead of 100k queued markers
+            mux.close()
+            self.etcd.store.watcher_hub.remove_many(watchers)
+            try:
+                self._write_chunk(b"")  # terminating chunk
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+
+    def _replay_member(self, w, mid: int, spec) -> None:
+        """Stream one mux member's deferred history catch-up
+        ``[w.replay, w.since_index)`` to the wire — live dispatch
+        (which starts at ``since_index``) neither overlaps nor gaps
+        it.  A compaction that outruns the replay surfaces as an
+        honest per-member error + closure."""
+        from ..store import clean_path
+        from ..utils.errors import EtcdError as _EE
+
+        key = clean_path(spec[0])
+        recursive = spec[1]
+        eh = self.etcd.store.watcher_hub.event_history
+        nxt = w.replay
+        while nxt < w.since_index:
+            try:
+                ev = eh.scan(key, recursive, nxt)
+            except _EE as err:
+                self._write_chunk((json.dumps(
+                    {"watch": mid,
+                     "error": json.loads(err.to_json())})
+                    + "\n").encode())
+                w.remove()  # closed marker arrives via the mux
+                return
+            if ev is None or ev.index() >= w.since_index:
+                return
+            line = {"watch": mid}
+            line.update(ev.to_dict())
+            self._write_chunk((json.dumps(line) + "\n").encode())
+            nxt = ev.index() + 1
 
     def _serve_stats(self, method: str, path: str) -> None:
         """/v2/stats/{self,store,leader} — observability endpoints
@@ -410,9 +598,12 @@ class EtcdRequestHandler(BaseHTTPRequestHandler):
             return
         self._reply(204, b"")
 
-    def _handle_watch(self, watcher, stream: bool) -> None:
+    def _handle_watch(self, watcher, stream: bool,
+                      keepalive: float | None = None) -> None:
         """Long-poll / chunked streaming watch
         (reference handleWatch, http.go:343-386)."""
+        if keepalive is None:
+            keepalive = self.watch_keepalive
         try:
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
@@ -425,6 +616,7 @@ class EtcdRequestHandler(BaseHTTPRequestHandler):
             self.wfile.flush()
 
             deadline = time.monotonic() + self.watch_timeout
+            last_write = time.monotonic()
             while True:
                 remain = deadline - time.monotonic()
                 if remain <= 0:
@@ -433,9 +625,18 @@ class EtcdRequestHandler(BaseHTTPRequestHandler):
                 if ev is None:
                     if watcher.removed:
                         break
+                    # idle stream keepalive: a blank JSON line (a
+                    # chunk clients skip) so read timeouts and
+                    # middleboxes don't reap a healthy stream
+                    if stream and keepalive and \
+                            (time.monotonic() - last_write
+                             >= keepalive):
+                        self._write_chunk(b"\n")
+                        last_write = time.monotonic()
                     continue
                 body = (json.dumps(ev.to_dict()) + "\n").encode()
                 self._write_chunk(body)
+                last_write = time.monotonic()
                 if not stream:
                     break
                 self.wfile.flush()
@@ -463,13 +664,15 @@ class _Server(ThreadingHTTPServer):
 def _make_handler_class(etcd: EtcdServer, mode: str,
                         cors: set[str] | None = None,
                         server_timeout: float = DEFAULT_SERVER_TIMEOUT,
-                        watch_timeout: float = DEFAULT_WATCH_TIMEOUT):
+                        watch_timeout: float = DEFAULT_WATCH_TIMEOUT,
+                        watch_keepalive: float = DEFAULT_WATCH_KEEPALIVE):
     return type("Handler", (EtcdRequestHandler,), {
         "etcd": etcd,
         "mode": mode,
         "cors": cors,
         "server_timeout": server_timeout,
         "watch_timeout": watch_timeout,
+        "watch_keepalive": watch_keepalive,
     })
 
 

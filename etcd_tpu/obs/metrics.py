@@ -245,6 +245,35 @@ _DEFS = (
         "with ETCD_FLIGHT_RING), unsampled is NOT counted (head "
         "sampling is a rate, not a loss).", labels=("reason",)),
     MetricDef(
+        "etcd_watchers_active", "gauge",
+        "Live registered watchers across this process's stores "
+        "(incremented at registration, decremented at removal or "
+        "eviction — co-hosted servers aggregate)."),
+    MetricDef(
+        "etcd_watch_delivered_total", "counter",
+        "Watch events delivered to watcher queues / mux sinks by "
+        "the fanout engine (PR 9)."),
+    MetricDef(
+        "etcd_watch_evictions_total", "counter",
+        "Slow watchers evicted, by reason: overflow (bounded queue "
+        "full under the default non-blocking policy) | stall "
+        "(backpressure mode: the ETCD_WATCH_BLOCK_S deadline "
+        "expired with the queue still full).", labels=("reason",)),
+    MetricDef(
+        "etcd_watch_dispatch_seconds", "histogram",
+        "Fanout engine wall time per dispatch round, split by "
+        "stage: match (hashed exact/recursive-prefix table "
+        "resolution + history insertion, under the hub mutex only) "
+        "| deliver (watcher-queue puts, outside every lock — the "
+        "stage split proving no watcher work rides the store's "
+        "world lock).", labels=("stage",), window=2048),
+    MetricDef(
+        "etcd_ttl_expire_batch_size", "histogram",
+        "Keys expired per bulk TTL sweep (one SYNC apply drains "
+        "the whole heap prefix in one pass and emits one EXPIRE "
+        "batch through the fanout engine; empty sweeps are not "
+        "observed).", buckets=SIZE_BUCKETS, window=2048),
+    MetricDef(
         "etcd_lint_findings", "gauge",
         "Findings per checker in the last static-analysis run "
         "(baselined findings included; suppressed ones not).",

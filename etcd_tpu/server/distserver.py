@@ -204,6 +204,11 @@ class DistServer:
             "big") & (2**63 - 1)
 
         self.store = Store()
+        # watch fanout on its own delivery stage (PR 9):
+        # _apply_committed runs under self.lock, so watcher-queue
+        # work there would stall every handler and the round loop —
+        # the engine thread takes it instead
+        self.store.fanout.start()
         self.w = Wait()
         self.done = threading.Event()
         self.lock = threading.RLock()
@@ -729,6 +734,7 @@ class DistServer:
             chan.close()  # fails in-flight frames; done-guard drops
         self._pool.close()
         self._ri_pool.close()
+        self.store.fanout.close()
         # a deferred snapshot may still hold _snap_mutex mid-save;
         # join it before closing the WAL (its cut/gc would raise on
         # a closed file).  Same wedge rule as the round loop: if it
@@ -2464,6 +2470,36 @@ class DistServer:
             return
         t_apply = time.perf_counter()
         n_apply = int((commit - self.applied)[newly].sum())
+        # batch the whole commit window into ONE fanout dispatch; the
+        # round scope keeps watcher matching/delivery off this path
+        # (we hold self.lock here — the engine thread picks it up)
+        with self.store.fanout_round():
+            self._apply_window(assigned, mr, commit, newly)
+        self._m_apply_n.observe(n_apply)
+        self._m_apply_s.observe(time.perf_counter() - t_apply)
+        mr.mark_applied(self.applied)
+        # follower linearizable reads park on commit-index
+        # wait-points; the advanced apply frontier releases them
+        if self._waits.pending:
+            for ch in self._waits.release(self.applied):
+                ch.close(True)
+        # lane-fill compaction, decoupled from the snap_count-gated
+        # snapshot: periodic SYNC entries alone would fill a group's
+        # fixed-cap log window on an idle cluster long before 10k
+        # applies accumulate, wedging that lane permanently
+        st = mr.state
+        fill = np.asarray(st.last) - np.asarray(st.offset)
+        if (fill > (mr.cap * 3) // 4).any():
+            mr.compact()
+        if self.raft_index - self._snapi > self.snap_count:
+            # deferred to the round loop: _apply_committed runs
+            # under self.lock (round loop AND ack/handler threads),
+            # and snapshot()'s disk I/O must not run there
+            self._want_snap = True
+
+    def _apply_window(self, assigned, mr, commit, newly) -> None:
+        """Per-group apply loop (split from _apply_committed so the
+        fanout round brackets exactly the store mutations)."""
         for gi in np.nonzero(newly)[0]:
             for idx in range(int(self.applied[gi]) + 1,
                              int(commit[gi]) + 1):
@@ -2519,27 +2555,6 @@ class DistServer:
                     and self._elected_at[gi] > 0.0
                     and self.applied[gi] > self._applied_at_elect[gi]):
                 self._first_apply_at[gi] = time.time()
-        self._m_apply_n.observe(n_apply)
-        self._m_apply_s.observe(time.perf_counter() - t_apply)
-        mr.mark_applied(self.applied)
-        # follower linearizable reads park on commit-index
-        # wait-points; the advanced apply frontier releases them
-        if self._waits.pending:
-            for ch in self._waits.release(self.applied):
-                ch.close(True)
-        # lane-fill compaction, decoupled from the snap_count-gated
-        # snapshot: periodic SYNC entries alone would fill a group's
-        # fixed-cap log window on an idle cluster long before 10k
-        # applies accumulate, wedging that lane permanently
-        st = mr.state
-        fill = np.asarray(st.last) - np.asarray(st.offset)
-        if (fill > (mr.cap * 3) // 4).any():
-            mr.compact()
-        if self.raft_index - self._snapi > self.snap_count:
-            # deferred to the round loop: _apply_committed runs
-            # under self.lock (round loop AND ack/handler threads),
-            # and snapshot()'s disk I/O must not run there
-            self._want_snap = True
 
     # -- snapshot / catch-up ----------------------------------------------
 

@@ -140,6 +140,9 @@ class MultiGroupServer:
             hashlib.sha1(name.encode()).digest()[:8], "big") & (2**63 - 1)
 
         self.store = Store()
+        # decoupled watch delivery (PR 9): the fused apply loop only
+        # queues events; match + watcher puts run on the engine thread
+        self.store.fanout.start()
         self.w = Wait()
         self.done = threading.Event()
         self._thread: threading.Thread | None = None
@@ -408,6 +411,7 @@ class MultiGroupServer:
         if self._thread is not None \
                 and self._thread is not threading.current_thread():
             self._thread.join(timeout=10)
+        self.store.fanout.close()
         self.wal.close()
 
     # -- client request path ----------------------------------------------
@@ -692,6 +696,10 @@ class MultiGroupServer:
 
     def _apply_newly(self, assigned, commit, newly) -> None:
         mr = self.mr
+        with self.store.fanout_round():
+            self._apply_newly_inner(assigned, commit, newly, mr)
+
+    def _apply_newly_inner(self, assigned, commit, newly, mr) -> None:
         for gi in np.nonzero(newly)[0]:
             for idx in range(int(self.applied[gi]) + 1,
                              int(commit[gi]) + 1):

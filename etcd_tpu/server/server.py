@@ -215,6 +215,14 @@ class EtcdServer:
         if self._thread is not None \
                 and self._thread is not threading.current_thread():
             self._thread.join(timeout=5)
+        # release the fanout dispatcher/delivery threads AFTER the
+        # apply loop joined — a batch it submits mid-shutdown must
+        # still dispatch (close drains the queue before exiting; a
+        # close-then-submit would strand events).  getattr: test
+        # scaffolds build bare servers without a store
+        st = getattr(self, "store", None)
+        if st is not None:
+            st.fanout.close()
 
     # -- raft message input ------------------------------------------------
 
@@ -271,7 +279,10 @@ class EtcdServer:
             with tracer.stage("server.send"):
                 self.send(rd.messages)
 
-            with tracer.stage("server.apply"):
+            # one fanout dispatch per committed batch: mutations only
+            # queue their events; match + watcher delivery happen on
+            # the engine's thread after this block (PR 9)
+            with tracer.stage("server.apply"), self.store.fanout_round():
                 for e in rd.committed_entries:
                     if e.type == ENTRY_NORMAL:
                         r = Request.unmarshal(e.data)
@@ -572,6 +583,10 @@ def new_server(cfg: ServerConfig, *, discoverer=None,
                      keep=int(os.environ.get("ETCD_SNAP_KEEP",
                                              DEFAULT_SNAP_KEEP)))
     st = Store()
+    # watch fanout runs on its own delivery stage so the apply loop
+    # never blocks on watcher queues (PR 9; ETCD_WATCH_WORKERS scales
+    # delivery threads)
+    st.fanout.start()
     m = cfg.cluster.find_name(cfg.name)
     waldir = os.path.join(cfg.data_dir, "wal")
 

@@ -17,9 +17,16 @@ from .event import (
     SET,
     UPDATE,
 )
+from .fanout import FanoutEngine, WatchMux
 from .store import MIN_EXPIRE_TIME, Store, clean_path
 from .node_internal import PERMANENT
-from .watcher import Watcher, WatcherHub
+from .watcher import (
+    NOTIFY_EVICTED,
+    NOTIFY_SENT,
+    NOTIFY_SKIPPED,
+    Watcher,
+    WatcherHub,
+)
 
 __all__ = [
     "Store",
@@ -27,6 +34,11 @@ __all__ = [
     "NodeExtern",
     "Watcher",
     "WatcherHub",
+    "FanoutEngine",
+    "WatchMux",
+    "NOTIFY_SKIPPED",
+    "NOTIFY_SENT",
+    "NOTIFY_EVICTED",
     "PERMANENT",
     "MIN_EXPIRE_TIME",
     "clean_path",

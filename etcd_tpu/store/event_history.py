@@ -73,14 +73,19 @@ class EventHistory:
                     return None
 
     def clone(self) -> "EventHistory":
-        c = EventHistory(self.queue.capacity)
-        c.queue.events = list(self.queue.events)
-        c.queue.size = self.queue.size
-        c.queue.front = self.queue.front
-        c.queue.back = self.queue.back
-        c.start_index = self.start_index
-        c.last_index = self.last_index
-        return c
+        # under _lock: since PR 9 the fanout engine appends history on
+        # its own thread (hub mutex + this lock, not the store world
+        # lock), so a snapshot clone racing a dispatch could tear
+        # front/back against the events array without it
+        with self._lock:
+            c = EventHistory(self.queue.capacity)
+            c.queue.events = list(self.queue.events)
+            c.queue.size = self.queue.size
+            c.queue.front = self.queue.front
+            c.queue.back = self.queue.back
+            c.start_index = self.start_index
+            c.last_index = self.last_index
+            return c
 
     def to_json_dict(self) -> dict:
         return {
@@ -100,14 +105,41 @@ class EventHistory:
     def from_json_dict(cls, d: dict) -> "EventHistory":
         q = d.get("Queue") or {}
         eh = cls(q.get("Capacity") or 1000)
-        eh.queue.events = [Event.from_dict(x) if x else None
-                           for x in q.get("Events", [])]
-        if len(eh.queue.events) < eh.queue.capacity:
-            eh.queue.events += [None] * (eh.queue.capacity
-                                         - len(eh.queue.events))
-        eh.queue.size = q.get("Size", 0)
-        eh.queue.front = q.get("Front", 0)
-        eh.queue.back = q.get("Back", 0)
-        eh.start_index = d.get("StartIndex", 0)
-        eh.last_index = d.get("LastIndex", 0)
+        events = [Event.from_dict(x) if x else None
+                  for x in q.get("Events", [])]
+        size = q.get("Size", 0)
+        front = q.get("Front", 0)
+        if len(events) == eh.queue.capacity:
+            # consistent snapshot: adopt the ring as stored
+            eh.queue.events = events
+            eh.queue.size = size
+            eh.queue.front = front
+            eh.queue.back = q.get("Back", 0)
+            eh.start_index = d.get("StartIndex", 0)
+            eh.last_index = d.get("LastIndex", 0)
+            return eh
+        # Events/Capacity mismatch (capacity drift across versions or
+        # a hand-carried snapshot): the stored front/back arithmetic
+        # is meaningless against a differently-sized array — an
+        # oversized Events list would otherwise corrupt every wrap.
+        # Linearize the stored ring oldest-first, keep the NEWEST
+        # ``capacity`` events, and rebuild a dense ring.
+        ordered = []
+        if events:
+            n = len(events)
+            for i in range(min(size, n)):
+                e = events[(front + i) % n]
+                if e is not None:
+                    ordered.append(e)
+        ordered = ordered[-eh.queue.capacity:]
+        eh.queue.events = (ordered
+                           + [None] * (eh.queue.capacity
+                                       - len(ordered)))
+        eh.queue.size = len(ordered)
+        eh.queue.front = 0
+        eh.queue.back = len(ordered) % eh.queue.capacity
+        eh.start_index = (ordered[0].index() if ordered
+                          else d.get("StartIndex", 0))
+        eh.last_index = (ordered[-1].index() if ordered
+                         else d.get("LastIndex", 0))
         return eh

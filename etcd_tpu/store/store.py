@@ -59,8 +59,13 @@ from .stats import (
     UPDATE_FAIL,
     UPDATE_SUCCESS,
 )
+from .fanout import Emit, FanoutEngine
 from .ttl_heap import TTLKeyHeap
 from .watcher import Watcher, WatcherHub
+
+from ..obs import metrics as _obs
+
+_M_TTL_BATCH = _obs.registry.histogram("etcd_ttl_expire_batch_size")
 
 DEFAULT_VERSION = 2
 
@@ -112,6 +117,15 @@ class Store:
         self.watcher_hub = WatcherHub(history_capacity)
         self.ttl_key_heap = TTLKeyHeap()
         self.world_lock = threading.RLock()
+        # batched watch fanout (PR 9): mutations append committed
+        # events here (under the world lock) and the engine matches +
+        # delivers them AFTER the lock is released — per mutation on
+        # a bare store, per apply round on the server tiers
+        # (fanout_round), on the engine's own threads once a server
+        # called fanout.start()
+        self.fanout = FanoutEngine(self.watcher_hub)
+        self._pending: list[Emit] = []
+        self._round_depth = 0
 
     # -- queries -----------------------------------------------------------
 
@@ -180,6 +194,25 @@ class Store:
             self.stats.inc(GET_FAIL, fail)
         return out
 
+    # -- fanout plumbing (PR 9) --------------------------------------------
+
+    def _emit(self, e: Event, removed: list[str] | None = None) -> None:
+        """Record a committed event for fanout.  Call with the world
+        lock held; dispatch happens after release — immediately
+        (``fanout.kick`` at the end of the mutation) or at the end of
+        the enclosing ``fanout_round``."""
+        self._pending.append(Emit(e, removed))
+        if self._round_depth == 0:
+            batch, self._pending = self._pending, []
+            self.fanout.submit(batch)
+
+    def fanout_round(self):
+        """Context manager batching every mutation inside it into ONE
+        fanout dispatch — the apply loops wrap each committed batch in
+        this, so an apply round costs one match sweep instead of a
+        hub round trip per event."""
+        return _FanoutRound(self)
+
     # -- mutations ---------------------------------------------------------
 
     def create(self, node_path: str, dir: bool, value: str, unique: bool,
@@ -193,9 +226,10 @@ class Store:
                 self.stats.inc(CREATE_FAIL)
                 raise
             e.etcd_index = self.current_index
-            self.watcher_hub.notify(e)
+            self._emit(e)
             self.stats.inc(CREATE_SUCCESS)
-            return e
+        self.fanout.kick()
+        return e
 
     def set(self, node_path: str, dir: bool, value: str,
             expire_time: float | None) -> Event:
@@ -219,9 +253,10 @@ class Store:
                 ext = prev.repr(False, False)
                 ext.key = clean_path(node_path)
                 e.prev_node = ext
-            self.watcher_hub.notify(e)
+            self._emit(e)
             self.stats.inc(SET_SUCCESS)
-            return e
+        self.fanout.kick()
+        return e
 
     def update(self, node_path: str, new_value: str,
                expire_time: float | None) -> Event:
@@ -254,10 +289,11 @@ class Store:
             n.update_ttl(expire_time)
             e.node.expiration, e.node.ttl = n.expiration_and_ttl()
 
-            self.watcher_hub.notify(e)
-            self.stats.inc(UPDATE_SUCCESS)
             self.current_index = next_index
-            return e
+            self._emit(e)
+            self.stats.inc(UPDATE_SUCCESS)
+        self.fanout.kick()
+        return e
 
     def compare_and_swap(self, node_path: str, prev_value: str,
                          prev_index: int, value: str,
@@ -295,9 +331,10 @@ class Store:
             e.node.value = value
             e.node.expiration, e.node.ttl = n.expiration_and_ttl()
 
-            self.watcher_hub.notify(e)
+            self._emit(e)
             self.stats.inc(COMPARE_AND_SWAP_SUCCESS)
-            return e
+        self.fanout.kick()
+        return e
 
     def delete(self, node_path: str, dir: bool, recursive: bool) -> Event:
         """Reference store.go:254-306."""
@@ -320,19 +357,21 @@ class Store:
             if n.is_dir():
                 e.node.dir = True
 
-            def callback(path: str) -> None:
-                self.watcher_hub.notify_watchers(e, path, True)
-
+            # removed subtree paths collect into the emit record; the
+            # engine notifies each with deleted=True (the reference's
+            # callback -> notifyWatchers shape, store.go:254-306)
+            removed: list[str] = []
             try:
-                n.remove(dir, recursive, callback)
+                n.remove(dir, recursive, removed.append)
             except EtcdError:
                 self.stats.inc(DELETE_FAIL)
                 raise
 
             self.current_index += 1
-            self.watcher_hub.notify(e)
+            self._emit(e, removed)
             self.stats.inc(DELETE_SUCCESS)
-            return e
+        self.fanout.kick()
+        return e
 
     def compare_and_delete(self, node_path: str, prev_value: str,
                            prev_index: int) -> Event:
@@ -362,13 +401,12 @@ class Store:
             e.etcd_index = self.current_index
             e.prev_node = n.repr(False, False)
 
-            def callback(path: str) -> None:
-                self.watcher_hub.notify_watchers(e, path, True)
-
-            n.remove(False, False, callback)
-            self.watcher_hub.notify(e)
+            removed = []
+            n.remove(False, False, removed.append)
+            self._emit(e, removed)
             self.stats.inc(COMPARE_AND_DELETE_SUCCESS)
-            return e
+        self.fanout.kick()
+        return e
 
     # -- watch -------------------------------------------------------------
 
@@ -387,30 +425,58 @@ class Store:
                 e.index = self.current_index
                 raise
 
+    def watch_many(self, specs, mux=None, mid_base: int = 0) -> list:
+        """Batched watch registration (PR 9): one world-lock take to
+        pin the since-index floor, then ONE hub-lock take for the
+        whole batch — 100k watches cost two lock round trips, not
+        100k.  ``specs`` is an iterable of
+        ``(key, recursive, stream, since_index)`` (since 0 = future
+        events only, like :meth:`watch`); returns a list aligned with
+        it of Watchers (or the per-spec EtcdError a compacted history
+        raised).  With ``mux`` set, events deliver into that shared
+        :class:`~.fanout.WatchMux` tagged ``mid_base`` + spec
+        position (callers registering in chunks pass the running
+        offset)."""
+        with self.world_lock:
+            cur = self.current_index
+        norm = [(clean_path(k), bool(r), bool(st),
+                 (cur + 1 if since == 0 else since))
+                for k, r, st, since in specs]
+        return self.watcher_hub.watch_many(norm, cur, mux=mux,
+                                           mid_base=mid_base)
+
     # -- TTL expiry --------------------------------------------------------
 
     def delete_expired_keys(self, cutoff: float) -> None:
-        """Pop and remove everything expiring at/before cutoff
+        """Remove everything expiring at/before cutoff
         (store.go:559-587).  Driven by the leader's SYNC proposal so
-        expiry is deterministic across the cluster."""
-        with self.world_lock:
-            while True:
-                node = self.ttl_key_heap.top()
-                if node is None or node.expire_time > cutoff:
-                    break
-                self.current_index += 1
-                e = new_event(EXPIRE, node.path, self.current_index,
-                              node.created_index)
-                e.etcd_index = self.current_index
-                e.prev_node = node.repr(False, False)
+        expiry is deterministic across the cluster.  The heap drains
+        in ONE pass under the world lock and the whole EXPIRE batch
+        rides one fanout dispatch — mass lease churn costs one match
+        sweep, and no watcher queue is touched under the lock
+        (PR 9; the per-key pop/notify loop was the 2014 shape)."""
+        n = 0
+        with self.fanout_round():
+            with self.world_lock:
+                while True:
+                    node = self.ttl_key_heap.top()
+                    if node is None or node.expire_time > cutoff:
+                        break
+                    self.current_index += 1
+                    e = new_event(EXPIRE, node.path, self.current_index,
+                                  node.created_index)
+                    e.etcd_index = self.current_index
+                    e.prev_node = node.repr(False, False)
 
-                def callback(path: str) -> None:
-                    self.watcher_hub.notify_watchers(e, path, True)
-
-                self.ttl_key_heap.pop()
-                node.remove(True, True, callback)
-                self.stats.inc(EXPIRE_COUNT)
-                self.watcher_hub.notify(e)
+                    removed: list[str] = []
+                    self.ttl_key_heap.pop()
+                    node.remove(True, True, removed.append)
+                    self._emit(e, removed)
+                    n += 1
+                if n:
+                    self.stats.inc(EXPIRE_COUNT, n)
+        if n:
+            _M_TTL_BATCH.observe(n)
 
     # -- internals ---------------------------------------------------------
 
@@ -518,6 +584,11 @@ class Store:
         """Clone under the world lock, serialize outside it
         (store.go:615-634).  JSON shape mirrors the reference's
         marshaled store struct so snapshots interoperate."""
+        # settle in-flight fanout first so the cloned event history
+        # covers every already-applied event (worker mode dispatches
+        # asynchronously; bounded wait — a stalled delivery must not
+        # block snapshots)
+        self.fanout.drain(timeout=1.0)
         with self.world_lock:
             root_clone = self.root.clone()
             hub_clone = self.watcher_hub.clone()
@@ -562,3 +633,27 @@ class Store:
 
     def total_transactions(self) -> int:
         return self.stats.total_transactions()
+
+
+class _FanoutRound:
+    """Reentrant deferred-dispatch scope (see Store.fanout_round)."""
+
+    __slots__ = ("store",)
+
+    def __init__(self, store: Store):
+        self.store = store
+
+    def __enter__(self):
+        with self.store.world_lock:
+            self.store._round_depth += 1
+        return self
+
+    def __exit__(self, *exc):
+        st = self.store
+        with st.world_lock:
+            st._round_depth -= 1
+            if st._round_depth == 0 and st._pending:
+                batch, st._pending = st._pending, []
+                st.fanout.submit(batch)
+        st.fanout.kick()
+        return False

@@ -59,3 +59,74 @@ def test_scan_before_window_raises_cleared():
     with pytest.raises(EtcdError) as ei:
         eh.scan("/k", False, 3)  # long compacted
     assert ei.value.error_code == ECODE_EVENT_INDEX_CLEARED
+
+
+# -- from_json_dict capacity reconciliation (PR 9 satellite) -----------------
+
+def test_from_json_dict_roundtrip_same_capacity_is_exact():
+    eh = EventHistory(8)
+    for i in range(1, 13):  # wraps the ring
+        eh.add_event(_ev("/k%d" % i, i))
+    eh2 = EventHistory.from_json_dict(eh.to_json_dict())
+    assert eh2.start_index == eh.start_index
+    assert eh2.last_index == eh.last_index
+    assert eh2.queue.front == eh.queue.front
+    assert eh2.queue.back == eh.queue.back
+    for i in range(eh.start_index, eh.last_index + 1):
+        assert eh2.scan("/k%d" % i, False, i).index() == i
+
+
+def test_from_json_dict_oversized_events_clamped():
+    """An Events list LONGER than the stored Capacity must be clamped
+    to the newest capacity events — adopting it verbatim corrupts the
+    ring's front/back modulo arithmetic on every subsequent insert."""
+    eh = EventHistory(10)
+    for i in range(1, 11):
+        eh.add_event(_ev("/k%d" % i, i))
+    d = eh.to_json_dict()
+    d["Queue"]["Capacity"] = 4  # capacity drift: array is 10 long
+    eh2 = EventHistory.from_json_dict(d)
+    assert eh2.queue.capacity == 4
+    assert len(eh2.queue.events) == 4
+    # the NEWEST 4 events survive with coherent indices
+    assert eh2.start_index == 7
+    assert eh2.last_index == 10
+    assert eh2.scan("/k9", False, 9).index() == 9
+    with pytest.raises(EtcdError):
+        eh2.scan("/k3", False, 3)
+    # ring arithmetic is sane after load: inserts wrap correctly
+    for i in range(11, 31):
+        eh2.add_event(_ev("/k%d" % i, i))
+        assert eh2.scan("/k%d" % i, False, i).index() == i
+    assert eh2.start_index == 27
+
+
+def test_from_json_dict_wrapped_oversized_ring_keeps_order():
+    eh = EventHistory(6)
+    for i in range(1, 16):  # wrapped ring: front != 0
+        eh.add_event(_ev("/w%d" % i, i))
+    d = eh.to_json_dict()
+    d["Queue"]["Capacity"] = 3
+    eh2 = EventHistory.from_json_dict(d)
+    assert (eh2.start_index, eh2.last_index) == (13, 15)
+    for i in (13, 14, 15):
+        assert eh2.scan("/w%d" % i, False, i).index() == i
+
+
+def test_from_json_dict_undersized_events_rebuilt():
+    """Events SHORTER than Capacity (a producer that trimmed nulls):
+    rebuilt dense, scans and inserts stay coherent."""
+    eh = EventHistory(4)
+    for i in range(1, 5):
+        eh.add_event(_ev("/u%d" % i, i))
+    d = eh.to_json_dict()
+    d["Queue"]["Capacity"] = 16
+    eh2 = EventHistory.from_json_dict(d)
+    assert eh2.queue.capacity == 16
+    assert len(eh2.queue.events) == 16
+    assert (eh2.start_index, eh2.last_index) == (1, 4)
+    for i in range(1, 5):
+        assert eh2.scan("/u%d" % i, False, i).index() == i
+    for i in range(5, 25):
+        eh2.add_event(_ev("/u%d" % i, i))
+        assert eh2.scan("/u%d" % i, False, i).index() == i

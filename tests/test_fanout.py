@@ -1,0 +1,634 @@
+"""Watch/TTL fanout subsystem tests (PR 9): batched dispatch engine,
+slow-watcher policy, batched registration, bulk TTL sweeps, and the
+lock-hold invariant (no watcher-queue work under the store world
+lock)."""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from etcd_tpu.obs.metrics import registry
+from etcd_tpu.store import (
+    NOTIFY_EVICTED,
+    NOTIFY_SENT,
+    NOTIFY_SKIPPED,
+    PERMANENT,
+    Store,
+    WatchMux,
+    Watcher,
+)
+from etcd_tpu.store.event import new_event
+from etcd_tpu.store.watcher import BoundedEventQueue, is_hidden
+from etcd_tpu.utils.errors import ECODE_EVENT_INDEX_CLEARED, EtcdError
+
+
+def _drain(w, timeout=0.05):
+    out = []
+    while True:
+        e = w.next_event(timeout=timeout)
+        if e is None:
+            return out
+        out.append(e)
+
+
+def _evictions(reason):
+    return registry.counter("etcd_watch_evictions_total",
+                            reason=reason).get()
+
+
+# -- is_hidden semantics (satellite: direct coverage) ------------------------
+
+@pytest.mark.parametrize("watch,key,hidden", [
+    ("/foo", "/foo/_bar", True),        # hidden child
+    ("/foo", "/foo/_bar/baz", True),    # inside a hidden subtree
+    ("/foo", "/foo/bar", False),
+    ("/foo", "/foo/bar/_deep", True),   # hidden at any depth below
+    ("/_foo", "/_foo/bar", False),      # watcher INSIDE hidden scope
+    ("/_foo/bar", "/_foo/bar/baz", False),
+    ("/", "/_top", True),
+    ("/", "/plain", False),
+    ("/foo/bar", "/foo", False),        # watch deeper than key: not hidden
+    ("/foo", "/foo", False),            # identical paths
+])
+def test_is_hidden_matrix(watch, key, hidden):
+    assert is_hidden(watch, key) is hidden
+
+
+def test_engine_hidden_rule_matches_is_hidden():
+    """The engine's depth-indexed hidden rule must agree with
+    is_hidden for recursive ancestor watchers."""
+    s = Store()
+    w_above = s.watch("/a", True, True, 0)
+    w_at = s.watch("/a/_h", True, True, 0)
+    w_root = s.watch("/", True, True, 0)
+    s.set("/a/_h/k", False, "v", PERMANENT)
+    s.set("/a/plain", False, "v", PERMANENT)
+    above = _drain(w_above)
+    assert [e.node.key for e in above] == ["/a/plain"]
+    at = _drain(w_at)
+    assert [e.node.key for e in at] == ["/a/_h/k"]
+    root = _drain(w_root)
+    assert [e.node.key for e in root] == ["/a/plain"]
+
+
+# -- notify outcome split (satellite: eviction is distinct) ------------------
+
+def test_notify_returns_typed_outcomes():
+    s = Store()
+    hub = s.watcher_hub
+    w = hub.watch("/k", False, True, 1, 0)
+    e = new_event("set", "/k", 5, 5)
+    assert w.notify(e, True, False) == NOTIFY_SENT
+    assert w.notify(e, False, False) == NOTIFY_SKIPPED  # not recursive
+    old = new_event("set", "/k", 0, 0)
+    assert w.notify(old, True, False) == NOTIFY_SKIPPED  # below since
+
+    # legacy truthiness is preserved: SENT is truthy, SKIPPED falsy
+    assert bool(NOTIFY_SENT) and not bool(NOTIFY_SKIPPED)
+
+
+def test_eviction_is_distinct_outcome_and_counted():
+    s = Store()
+    hub = s.watcher_hub
+    w = hub.watch("/k", False, True, 1, 0)
+    before = _evictions("overflow")
+    e = new_event("set", "/k", 5, 5)
+    for _ in range(w.event_queue.maxsize):
+        assert w.notify(e, True, False) == NOTIFY_SENT
+    assert w.notify(e, True, False) == NOTIFY_EVICTED
+    assert _evictions("overflow") == before + 1
+    assert w.removed
+    assert hub.count == 0
+    # removal rode _remove_cb exactly once: count stayed consistent
+    # and a second notify is a no-op eviction-wise
+    assert w.notify(e, True, False) == NOTIFY_EVICTED
+    assert hub.count == 0
+
+
+def test_oneshot_eviction_no_double_close():
+    """The pre-PR-9 bug: an evicted one-shot returned True, so
+    notify_watchers ran the close path AGAIN (double _CLOSED
+    sentinel).  Now the drain sees exactly the sacrificed-slot
+    shape: maxsize-1 events then one closure."""
+    s = Store()
+    hub = s.watcher_hub
+    w = hub.watch("/k", False, False, 1, 0)
+    # fill the queue bypassing notify (simulates a stalled consumer)
+    e = new_event("set", "/k", 5, 5)
+    for _ in range(w.event_queue.maxsize):
+        w.event_queue.put_nowait(e)
+    hub.notify_watchers(e, "/k", False)  # overflows -> evicts
+    assert w.removed
+    got = _drain(w)
+    assert len(got) == w.event_queue.maxsize - 1  # one slot sacrificed
+    # closed: drain terminated via the sentinel, queue now empty
+    with pytest.raises(queue.Empty):
+        w.event_queue.get_nowait()
+
+
+# -- engine dispatch semantics ----------------------------------------------
+
+def test_round_batches_one_dispatch():
+    s = Store()
+    r0 = s.fanout.rounds
+    with s.fanout_round():
+        for i in range(10):
+            s.set(f"/r/k{i}", False, "v", PERMANENT)
+    assert s.fanout.rounds == r0 + 1
+
+
+def test_round_events_still_delivered_in_order():
+    s = Store()
+    w = s.watch("/r", True, True, 0)
+    with s.fanout_round():
+        for i in range(10):
+            s.set(f"/r/k{i}", False, str(i), PERMANENT)
+    got = _drain(w)
+    assert [e.node.value for e in got] == [str(i) for i in range(10)]
+
+
+def test_delete_subtree_batch_notifies_inner_watchers():
+    s = Store()
+    s.set("/d/a/x", False, "1", PERMANENT)
+    s.set("/d/b/y", False, "2", PERMANENT)
+    wx = s.watch("/d/a/x", False, False, 0)
+    wrec = s.watch("/d/b", True, False, 0)
+    with s.fanout_round():
+        s.delete("/d", False, True)
+    assert _drain(wx)[0].action == "delete"
+    assert _drain(wrec)[0].action == "delete"
+
+
+class _SpyQueue(BoundedEventQueue):
+    """Instrumented watcher queue (BoundedEventQueue uses __slots__,
+    so tests swap the whole queue object)."""
+
+    def __init__(self, maxsize, on_put):
+        super().__init__(maxsize)
+        self._on_put = on_put
+
+    def put_nowait(self, item):
+        self._on_put(item)
+        super().put_nowait(item)
+
+
+def test_worker_mode_delivery_off_mutator_thread():
+    s = Store()
+    s.fanout.start(workers=1)
+    try:
+        seen = {}
+
+        w = s.watch("/k", False, True, 0)
+
+        def spy_put(item):
+            seen["thread"] = threading.current_thread().name
+            # the world lock must be FREE during delivery: nothing
+            # holds it at this point, so a non-blocking acquire from
+            # the delivering thread must succeed
+            seen["world_lock_free"] = s.world_lock.acquire(
+                blocking=False)
+            if seen["world_lock_free"]:
+                s.world_lock.release()
+
+        w.event_queue = _SpyQueue(100, spy_put)
+        s.set("/k", False, "v", PERMANENT)
+        assert w.next_event(timeout=2) is not None
+        assert seen["thread"].startswith("watch-fanout")
+        assert seen["world_lock_free"]
+    finally:
+        s.fanout.close()
+
+
+def test_worker_mode_slow_delivery_never_blocks_mutations():
+    """Block a delivery mid-flight; the store must keep accepting
+    mutations (the world lock and the apply path are decoupled from
+    the delivery stage)."""
+    s = Store()
+    s.fanout.start(workers=1)
+    try:
+        gate = threading.Event()
+        entered = threading.Event()
+        w = s.watch("/slow", False, True, 0)
+
+        def stalled_put(item):
+            entered.set()
+            assert gate.wait(5)
+
+        w.event_queue = _SpyQueue(100, stalled_put)
+        s.set("/slow", False, "v", PERMANENT)
+        assert entered.wait(2)
+        # delivery is stalled RIGHT NOW; mutations must still run
+        t0 = time.monotonic()
+        s.set("/other", False, "v", PERMANENT)
+        assert time.monotonic() - t0 < 1.0
+        assert s.get("/other", False, False).node.value == "v"
+        gate.set()
+        assert w.next_event(timeout=2) is not None
+    finally:
+        s.fanout.close()
+
+
+def test_backpressure_mode_blocks_instead_of_evicting():
+    s = Store()
+    s.fanout.overflow = "block"
+    s.fanout.block_s = 5.0
+    w = s.watch("/bp", False, True, 0)
+    w.event_queue.maxsize = 2
+    before = _evictions("overflow") + _evictions("stall")
+
+    done = threading.Event()
+
+    def producer():
+        for i in range(6):
+            s.set("/bp", False, str(i), PERMANENT)
+        done.set()
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    got = []
+    deadline = time.monotonic() + 10
+    while len(got) < 6 and time.monotonic() < deadline:
+        e = w.next_event(timeout=0.2)
+        if e is not None:
+            got.append(e.node.value)
+    assert got == [str(i) for i in range(6)]
+    assert done.wait(5)
+    assert _evictions("overflow") + _evictions("stall") == before
+    assert not w.removed
+
+
+def test_backpressure_stall_evicts_with_reason():
+    s = Store()
+    s.fanout.overflow = "block"
+    s.fanout.block_s = 0.05
+    w = s.watch("/st", False, True, 0)
+    w.event_queue.maxsize = 1
+    before = _evictions("stall")
+    s.set("/st", False, "a", PERMANENT)   # fills the queue
+    s.set("/st", False, "b", PERMANENT)   # stalls, then evicts
+    assert _evictions("stall") == before + 1
+    assert w.removed
+    assert s.watcher_hub.count == 0
+
+
+# -- concurrent removal races (satellite) ------------------------------------
+
+def test_stream_watcher_concurrent_remove_under_load():
+    s = Store()
+    for it in range(10):
+        w = s.watch("/c", False, True, 0)
+        stop = threading.Event()
+
+        def consumer():
+            while not stop.is_set():
+                w.next_event(timeout=0.01)
+
+        ct = threading.Thread(target=consumer, daemon=True)
+        ct.start()
+
+        def remover():
+            time.sleep(0.001 * (it % 3))
+            w.remove()
+
+        rt = threading.Thread(target=remover, daemon=True)
+        rt.start()
+        for i in range(50):
+            s.set("/c", False, str(i), PERMANENT)
+        rt.join(timeout=5)
+        stop.set()
+        ct.join(timeout=5)
+        w.remove()  # idempotent
+        assert s.watcher_hub.count == 0, f"iteration {it}"
+
+
+def test_oneshot_concurrent_remove_count_never_corrupts():
+    s = Store()
+    for it in range(20):
+        w = s.watch("/o", False, False, 0)
+
+        barrier = threading.Barrier(2)
+
+        def remover():
+            barrier.wait()
+            w.remove()
+
+        rt = threading.Thread(target=remover, daemon=True)
+        rt.start()
+        barrier.wait()
+        s.set("/o", False, "v", PERMANENT)  # fires the one-shot
+        rt.join(timeout=5)
+        assert s.watcher_hub.count == 0, f"iteration {it}"
+        # consumer observes either the event or clean closure
+        _drain(w)
+
+
+# -- batched registration ----------------------------------------------------
+
+def test_watch_many_registers_in_one_batch():
+    s = Store()
+    specs = [(f"/m/k{i}", False, True, 0) for i in range(500)]
+    ws = s.watch_many(specs)
+    assert len(ws) == 500
+    assert s.watcher_hub.count == 500
+    s.set("/m/k7", False, "v", PERMANENT)
+    assert ws[7].next_event(timeout=1).node.value == "v"
+    assert ws[8].next_event(timeout=0.05) is None
+    s.watcher_hub.remove_many(ws)
+    assert s.watcher_hub.count == 0
+
+
+def test_watch_many_serves_history_and_errors_per_spec():
+    s = Store(history_capacity=2)
+    for i in range(5):
+        s.set("/h/k%d" % i, False, "v", PERMANENT)
+    idx = s.index()
+    out = s.watch_many([
+        ("/h/k4", False, False, idx),   # in-window: history serve
+        ("/h/k0", False, False, 1),     # compacted: per-spec error
+        ("/h/new", False, False, 0),    # future: registered
+    ])
+    assert out[0].next_event(timeout=1).node.key == "/h/k4"
+    assert isinstance(out[1], EtcdError)
+    assert out[1].error_code == ECODE_EVENT_INDEX_CLEARED
+    assert not isinstance(out[2], EtcdError)
+    assert s.watcher_hub.count == 1  # only the future spec registered
+
+
+def test_watch_mux_tags_members_and_signals_closure():
+    s = Store()
+    mux = WatchMux()
+    ws = s.watch_many([
+        ("/x/a", False, True, 0),
+        ("/x/b", False, True, 0),
+        ("/x", True, False, 0),        # one-shot recursive
+    ], mux=mux)
+    s.set("/x/b", False, "vb", PERMANENT)
+    got = {}
+    closes = []
+    for _ in range(3):
+        item = mux.pop(timeout=1)
+        assert item is not None
+        mid, ev = item
+        if ev is None:
+            closes.append(mid)
+        else:
+            got.setdefault(mid, []).append(ev)
+    # member 1 (exact /x/b) and member 2 (recursive one-shot) fired;
+    # the one-shot then closed
+    assert [e.node.value for e in got[1]] == ["vb"]
+    assert [e.node.value for e in got[2]] == ["vb"]
+    assert closes == [2]
+    mux.close()
+    s.watcher_hub.remove_many(ws)
+    assert s.watcher_hub.count == 0
+
+
+def test_watch_mux_overflow_evicts_member():
+    s = Store()
+    mux = WatchMux(capacity=2)
+    ws = s.watch_many([("/of", False, True, 0)], mux=mux)
+    before = _evictions("overflow")
+    for i in range(4):
+        s.set("/of", False, str(i), PERMANENT)
+    assert _evictions("overflow") == before + 1
+    assert ws[0].removed
+    assert s.watcher_hub.count == 0
+
+
+# -- bulk TTL sweeps ----------------------------------------------------------
+
+def test_ttl_sweep_is_one_batch_with_size_metric():
+    s = Store()
+    now = time.time()
+    for i in range(50):
+        s.create(f"/ttl/k{i}", False, "v", False, now + 0.01)
+    ws = s.watch_many([(f"/ttl/k{i}", False, False, 0)
+                       for i in range(50)])
+    h = registry.histogram("etcd_ttl_expire_batch_size")
+    count0 = h.count
+    r0 = s.fanout.rounds
+    exp0 = s.stats.expire_count
+    s.delete_expired_keys(now + 1)
+    assert s.fanout.rounds == r0 + 1          # ONE dispatch round
+    assert h.count == count0 + 1              # one batch-size sample
+    assert s.stats.expire_count == exp0 + 50
+    for w in ws:
+        e = w.next_event(timeout=1)
+        assert e is not None and e.action == "expire"
+    assert len(s.ttl_key_heap) == 0
+
+
+def test_ttl_sweep_recursive_watcher_sees_every_expiry():
+    s = Store()
+    now = time.time()
+    for i in range(20):
+        s.create(f"/svc/n{i}", False, "v", False, now + 0.01)
+    w = s.watch("/svc", True, True, 0)
+    s.delete_expired_keys(now + 1)
+    got = _drain(w, timeout=0.2)
+    assert len(got) == 20
+    assert all(e.action == "expire" for e in got)
+    # expiry indices are contiguous and ordered (heap-pop order rides
+    # one batch)
+    idxs = [e.index() for e in got]
+    assert idxs == sorted(idxs)
+
+
+def test_ttl_sweep_inside_apply_round_defers_to_round_batch():
+    s = Store()
+    now = time.time()
+    for i in range(5):
+        s.create(f"/rt/k{i}", False, "v", False, now + 0.01)
+    r0 = s.fanout.rounds
+    with s.fanout_round():
+        s.set("/rt/other", False, "v", PERMANENT)
+        s.delete_expired_keys(now + 1)
+    assert s.fanout.rounds == r0 + 1
+
+
+# -- history/registration seam ------------------------------------------------
+
+def test_no_lost_event_across_registration_seam():
+    """A watcher registering concurrently with dispatch either serves
+    from history or is matched — never silently misses an event."""
+    s = Store()
+    s.fanout.start(workers=1)
+    try:
+        for i in range(50):
+            s.set("/seam", False, str(i), PERMANENT)
+            idx = s.index()
+            w = s.watch("/seam", False, False, idx)
+            e = w.next_event(timeout=2)
+            assert e is not None and e.node.value == str(i)
+            w.remove()
+    finally:
+        s.fanout.close()
+
+
+def test_save_includes_fanout_inflight_history():
+    s = Store()
+    s.fanout.start(workers=1)
+    try:
+        s.set("/snap/k", False, "v", PERMANENT)
+        blob = s.save()
+        s2 = Store()
+        s2.recovery(blob)
+        w = s2.watch("/snap/k", False, False, s.index())
+        assert w.next_event(timeout=1) is not None
+    finally:
+        s.fanout.close()
+
+
+def test_watchers_active_gauge_tracks_lifecycle():
+    g = registry.gauge("etcd_watchers_active")
+    s = Store()
+    base = g.get()
+    ws = s.watch_many([(f"/g/k{i}", False, True, 0) for i in range(10)])
+    assert g.get() == base + 10
+    s.watcher_hub.remove_many(ws)
+    assert g.get() == base
+
+
+# -- mux history catch-up (review hardening) ---------------------------------
+
+def test_mux_stream_history_hit_defers_replay_and_stays_live():
+    """A mux STREAM member whose since-index hits history must not be
+    orphaned: it registers for live events past the current window
+    and hands the consumer the replay range — NOT buffered through
+    the bounded mux, where a whole-window catch-up would evict the
+    member during registration."""
+    s = Store()
+    for i in range(1, 4):
+        s.set("/cu/k", False, str(i), PERMANENT)   # indices 1..3
+    mux = WatchMux()
+    ws = s.watch_many([("/cu/k", False, True, 2)], mux=mux)
+    w = ws[0]
+    # the member is REGISTERED (live) with the replay range recorded
+    assert s.watcher_hub.count == 1
+    assert w.replay == 2
+    assert w.since_index == 4   # live starts past the window
+    # consumer-side replay straight off the history ring (what the
+    # /v2/watch handler streams to the wire)
+    eh = s.watcher_hub.event_history
+    vals = []
+    nxt = w.replay
+    while nxt < w.since_index:
+        ev = eh.scan("/cu/k", False, nxt)
+        if ev is None or ev.index() >= w.since_index:
+            break
+        vals.append(ev.node.value)
+        nxt = ev.index() + 1
+    assert vals == ["2", "3"]
+    # nothing was pushed through the mux during registration
+    assert mux.pop(timeout=0.05) is None
+    # live events flow from since_index on, exactly once
+    s.set("/cu/k", False, "4", PERMANENT)
+    mid, ev = mux.pop(timeout=1)
+    assert (mid, ev.node.value) == (0, "4")
+    assert mux.pop(timeout=0.05) is None
+    mux.close()
+    s.watcher_hub.remove_many(ws)
+
+
+def test_mux_oneshot_history_hit_emits_closed_marker():
+    s = Store()
+    s.set("/cu/o", False, "v", PERMANENT)
+    mux = WatchMux()
+    s.watch_many([("/cu/o", False, False, 1)], mux=mux)
+    mid, ev = mux.pop(timeout=1)
+    assert mid == 0 and ev.node.value == "v"
+    mid, ev = mux.pop(timeout=1)
+    assert (mid, ev) == (0, None)   # completion marker
+    assert s.watcher_hub.count == 0
+
+
+def test_multi_worker_partition_spreads_and_preserves_order():
+    """hash-partitioned delivery workers: every watcher's events stay
+    ordered, and the partition function actually spreads (id() % n
+    parked everything on worker 0 for even n — 16-byte alignment)."""
+    s = Store()
+    s.fanout.start(workers=2)
+    try:
+        ws = s.watch_many([(f"/mw/k{i}", False, True, 0)
+                           for i in range(32)])
+        # the partition must not be degenerate for n=2
+        parts = {w._shard % 2 for w in ws}
+        assert parts == {0, 1}
+        with s.fanout_round():
+            for r in range(5):
+                for i in range(32):
+                    s.set(f"/mw/k{i}", False, f"{r}", PERMANENT)
+        for i, w in enumerate(ws):
+            vals = [e.node.value for e in _drain(w, timeout=0.5)[:5]]
+            assert vals == ["0", "1", "2", "3", "4"], f"watcher {i}"
+    finally:
+        s.fanout.close()
+
+
+def test_mux_stall_eviction_counted_as_stall():
+    s = Store()
+    s.fanout.overflow = "block"
+    s.fanout.block_s = 0.05
+    mux = WatchMux(capacity=1)
+    ws = s.watch_many([("/ms", False, True, 0)], mux=mux)
+    before = _evictions("stall")
+    s.set("/ms", False, "a", PERMANENT)   # fills the mux
+    s.set("/ms", False, "b", PERMANENT)   # stalls past block_s -> evict
+    assert _evictions("stall") == before + 1
+    assert ws[0].removed
+
+
+def test_server_stop_dispatches_shutdown_batch():
+    """EtcdServer.stop() must close the engine only AFTER the apply
+    loop joined: a batch submitted during shutdown still reaches
+    watchers (close() drains the queue before the thread exits)."""
+    s = Store()
+    s.fanout.start(workers=1)
+    w = s.watch("/sd", False, True, 0)
+    with s.fanout_round():
+        s.set("/sd", False, "last", PERMANENT)
+    s.fanout.close()  # close AFTER submit: must still deliver
+    assert w.next_event(timeout=2).node.value == "last"
+
+
+def test_evict_then_remove_emits_single_closed_marker():
+    """Evicted member later swept by remove()/remove_many: exactly
+    ONE closure signal — a duplicate mux closed marker would
+    double-decrement the serving side's open-member count and tear
+    the stream down early."""
+    s = Store()
+    mux = WatchMux(capacity=1)
+    ws = s.watch_many([("/dc", False, True, 0)], mux=mux)
+    s.set("/dc", False, "a", PERMANENT)   # fills the 1-slot mux
+    s.set("/dc", False, "b", PERMANENT)   # overflow -> evict + close
+    assert ws[0].removed
+    ws[0].remove()                        # handler teardown path
+    s.watcher_hub.remove_many(ws)
+    items = []
+    while True:
+        it = mux.pop(timeout=0.05)
+        if it is None:
+            break
+        items.append(it)
+    closes = [it for it in items if it[1] is None]
+    assert len(closes) == 1
+
+
+def test_close_with_workers_delivers_final_batch():
+    """close() drains: batches submitted just before shutdown reach
+    their watchers even with multiple delivery workers (the sentinel
+    must queue BEHIND the final partitions, not ahead of them)."""
+    for _ in range(5):
+        s = Store()
+        s.fanout.start(workers=2)
+        ws = s.watch_many([(f"/cl/k{i}", False, True, 0)
+                           for i in range(8)])
+        with s.fanout_round():
+            for i in range(8):
+                s.set(f"/cl/k{i}", False, "last", PERMANENT)
+        s.fanout.close()
+        for i, w in enumerate(ws):
+            e = w.next_event(timeout=2)
+            assert e is not None and e.node.value == "last", f"w{i}"

@@ -439,3 +439,203 @@ def test_stats_endpoints(live_server):
     assert "leader" in json.loads(body)
     code, _, _ = http("GET", f"{base}/v2/stats/bogus")
     assert code == 404
+
+
+# -- streaming keepalives + batched mux watch (PR 9) -------------------------
+
+def test_watch_stream_keepalive_on_idle(live_server):
+    """An idle streaming watch must emit blank keepalive chunks so
+    client read timeouts don't tear a healthy stream down."""
+    s = live_server["server"]
+    handler = make_client_handler(s, watch_timeout=5.0,
+                                  watch_keepalive=0.3)
+    from etcd_tpu.api import serve
+
+    httpd = serve(handler, "127.0.0.1", 0)
+    try:
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        url = base + "/v2/keys/http/ka?wait=true&stream=true"
+        req = urllib.request.Request(url)
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            # no events are published: the first line to arrive must
+            # be a keepalive (blank), within a couple of intervals
+            line = resp.readline()
+            assert line.strip() == b""
+    finally:
+        httpd.shutdown()
+
+
+def test_watch_many_mux_endpoint(live_server):
+    base = live_server["base"]
+    specs = [
+        {"key": "/mux/a"},
+        {"key": "/mux", "recursive": True},
+    ]
+    got = []
+    ready = threading.Event()
+
+    def reader():
+        req = urllib.request.Request(
+            base + "/v2/watch", data=json.dumps(specs).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            ready.set()
+            while len(got) < 3:
+                line = resp.readline()
+                if line.strip():
+                    got.append(json.loads(line))
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    assert ready.wait(5)
+    time.sleep(0.3)  # registration runs before the header flush
+    http("PUT", base + "/v2/keys/mux/a", {"value": "va"})
+    http("PUT", base + "/v2/keys/mux/b", {"value": "vb"})
+    t.join(timeout=10)
+    assert not t.is_alive()
+    # /mux/a fires members 0 (exact) and 1 (recursive); /mux/b only 1
+    fired = sorted((e["watch"], e["node"]["value"]) for e in got)
+    assert fired == [(0, "va"), (1, "va"), (1, "vb")]
+
+
+def test_watch_many_mux_rejects_non_array(live_server):
+    base = live_server["base"]
+    req = urllib.request.Request(
+        base + "/v2/watch", data=b'{"key": "/x"}', method="POST",
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=5)
+    assert ei.value.code == 400
+
+
+def test_client_watch_stream_generator(live_server):
+    base = live_server["base"]
+    c = Client([base])
+    got = []
+
+    def reader():
+        for ev in c.watch_stream("/cs/k"):
+            got.append(ev)
+            if len(got) >= 2:
+                break
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    http("PUT", base + "/v2/keys/cs/k", {"value": "1"})
+    time.sleep(0.1)
+    http("PUT", base + "/v2/keys/cs/k", {"value": "2"})
+    t.join(timeout=10)
+    assert [e["node"]["value"] for e in got] == ["1", "2"]
+
+
+def test_watch_many_mux_stream_ends_when_all_members_close(live_server):
+    """A batch whose members all fire one-shot must END the stream
+    (closed markers for every member, then EOF) instead of holding
+    the connection until watch_timeout."""
+    base = live_server["base"]
+    http("PUT", base + "/v2/keys/eos/k", {"value": "v0"})
+    lines = []
+
+    def reader():
+        req = urllib.request.Request(
+            base + "/v2/watch",
+            data=json.dumps([{"key": "/eos/k", "stream": False},
+                             {"key": "/eos/k", "stream": False}]).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            for line in resp:  # runs to EOF
+                if line.strip():
+                    lines.append(json.loads(line))
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    http("PUT", base + "/v2/keys/eos/k", {"value": "v1"})
+    t.join(timeout=10)
+    assert not t.is_alive()  # EOF well before the 5s watch_timeout
+    events = [x for x in lines if "node" in x]
+    closed = sorted(x["watch"] for x in lines if x.get("closed"))
+    assert len(events) == 2 and closed == [0, 1]
+
+
+def test_watch_many_chunked_registration_catchup(live_server):
+    """> WATCH_REG_CHUNK specs with history catch-up: registration is
+    chunked with the replay drained to the wire between chunks, so
+    member ids stay spec-aligned across chunk boundaries and no
+    member is evicted by registration-time buffering."""
+    base = live_server["base"]
+    http("PUT", base + "/v2/keys/chunk/k", {"value": "cv"})
+    s = live_server["server"]
+    idx = s.store.index()
+    n = 600  # > WATCH_REG_CHUNK (512)
+    specs = [{"key": "/chunk/k", "since": idx, "stream": False}
+             for _ in range(n)]
+    got = list(__import__("etcd_tpu.api.client",
+                          fromlist=["Client"]).Client(
+        [base]).watch_many(specs, timeout=30))
+    events = [x for x in got if "node" in x]
+    closed = [x for x in got if x.get("closed")]
+    assert len(events) == n                      # every member caught up
+    assert len(closed) == n                      # ...and closed (one-shot)
+    assert sorted(x["watch"] for x in events) == list(range(n))
+    # (global hub count is not asserted here: the module-scoped
+    # server still carries other tests' expiring watchers)
+
+
+def test_client_watch_stream_fails_over_dead_endpoint(live_server):
+    base = live_server["base"]
+    from etcd_tpu.api.client import Client
+    c = Client(["http://127.0.0.1:1", base], timeout=2)
+    got = []
+
+    def reader():
+        for ev in c.watch_stream("/fo/k", timeout=10):
+            got.append(ev)
+            break
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    time.sleep(0.5)
+    http("PUT", base + "/v2/keys/fo/k", {"value": "1"})
+    t.join(timeout=10)
+    assert [e["node"]["value"] for e in got] == ["1"]
+
+
+def test_watch_many_stream_member_catches_up_then_lives(live_server):
+    """A /v2/watch STREAM member with a lagging since: the handler
+    streams the whole in-window history to the wire (deferred
+    replay, not buffered through the mux) and live events follow."""
+    base = live_server["base"]
+    vals = ["a", "b", "c"]
+    first = None
+    for v in vals:
+        _, _, body = http("PUT", base + "/v2/keys/cup/k", {"value": v})
+        if first is None:
+            first = json.loads(body)["node"]["modifiedIndex"]
+    got = []
+    done = threading.Event()
+
+    def reader():
+        req = urllib.request.Request(
+            base + "/v2/watch",
+            data=json.dumps([{"key": "/cup/k",
+                              "since": first}]).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            while len(got) < 4:
+                line = resp.readline()
+                if line.strip():
+                    got.append(json.loads(line))
+        done.set()
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    time.sleep(0.5)  # replay should already be on the wire
+    http("PUT", base + "/v2/keys/cup/k", {"value": "live"})
+    assert done.wait(10)
+    assert [x["node"]["value"] for x in got] == ["a", "b", "c", "live"]
+    assert all(x["watch"] == 0 for x in got)
