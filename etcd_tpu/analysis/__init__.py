@@ -22,6 +22,10 @@ that cost whole rounds and that the 6-minute suite cannot see:
 - **metrics-vocabulary** (metricsvocab.py): every obs-registry
   accessor call uses a string-literal metric name registered in
   obs/metrics.py's CATALOG — no ad-hoc metric keys (PR 2).
+- **fault-vocabulary** (faultvocab.py): every fault-registry
+  ``hit()`` call uses a string-literal failpoint name registered in
+  utils/faults.py's FAULT_CATALOG — a typo'd failpoint would
+  silently never fire (PR 10).
 - **device-boundary** (boundary.py): ``np.asarray``/``np.array`` on
   a just-produced jitted result inside a per-round loop — the
   transfer-per-round tax behind the 24x restart regression (PR 3;
@@ -66,6 +70,7 @@ from .engine import (
     target_files,
 )
 from .errorvocab import ErrorVocabularyChecker
+from .faultvocab import FaultVocabularyChecker
 from .locks import LockDisciplineChecker
 from .metricsvocab import MetricsVocabularyChecker
 from .purity import TracerPurityChecker
@@ -80,6 +85,7 @@ ALL_CHECKERS = (
     DurabilityOrderingChecker(),
     ErrorVocabularyChecker(),
     MetricsVocabularyChecker(),
+    FaultVocabularyChecker(),
     DeviceBoundaryChecker(),
     StaticShapeChecker(),
     SeqContiguityChecker(),
@@ -94,6 +100,7 @@ __all__ = [
     "DeviceBoundaryChecker",
     "DurabilityOrderingChecker",
     "ErrorVocabularyChecker",
+    "FaultVocabularyChecker",
     "Finding",
     "LockDisciplineChecker",
     "MetricsVocabularyChecker",

@@ -36,6 +36,11 @@ _ALLOWED = {
     "StoppedError", "RaftPanicError", "WALError", "TornTailError",
     "FileNotFoundError_", "SnapError", "NoSnapshotError",
     "ProtoError", "FrameError", "DiscoveryError", "ClusterFullError",
+    # PR 10: EtcdNoSpace carries ECODE_NO_SPACE (an EtcdError
+    # subclass — listed for the bare-raise form); FrameDropped is
+    # the injected-loss control exception the peer handler turns
+    # into a closed connection
+    "EtcdNoSpace", "FrameDropped",
     # stdlib
     "ValueError", "TypeError", "KeyError", "IndexError",
     "AttributeError", "RuntimeError", "TimeoutError",

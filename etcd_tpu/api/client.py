@@ -5,9 +5,12 @@ round trips and long-poll watchers)."""
 from __future__ import annotations
 
 import json
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
+
+from ..utils.backoff import Backoff
 
 
 class ClientError(Exception):
@@ -22,14 +25,21 @@ class Client:
     needs; ours adds delete/set for the CLI and tests)."""
 
     def __init__(self, endpoints: list[str], timeout: float = 5.0,
-                 tls_info=None):
+                 tls_info=None, retries: int = 0):
         """``tls_info`` (utils.transport.TLSInfo): client context for
         https endpoints — client-cert auth + CA verification
-        (reference pkg/transport/listener.go:114-135)."""
+        (reference pkg/transport/listener.go:114-135).
+
+        ``retries``: extra full endpoint sweeps after every endpoint
+        failed to connect, paced by the shared jittered backoff
+        (``etcd_backoff_retries_total{site="client"}``).  Default 0
+        keeps the historical fail-fast behavior; drills and
+        long-lived clients opt in."""
         if not endpoints:
             raise ValueError("no endpoints")
         self.endpoints = [e.rstrip("/") for e in endpoints]
         self.timeout = timeout
+        self.retries = retries
         self._ssl = None
         if tls_info is not None and not tls_info.empty():
             self._ssl = tls_info.client_context()
@@ -45,29 +55,40 @@ class Client:
         single copy of the failover + error-vocabulary policy.
         Returns the OPEN response (caller reads or streams it);
         HTTP errors surface as ClientError, dead endpoints are
-        skipped."""
+        skipped.  With ``retries`` set, a fully-failed endpoint
+        sweep re-runs after a shared jittered-backoff wait (an
+        answered-but-erroring endpoint still fails fast: an HTTP
+        error is an answer, not an outage)."""
         last_err: Exception = ClientError(0, "no endpoints tried")
-        for ep in self.endpoints:
-            url = ep + path
-            if params:
-                url += "?" + urllib.parse.urlencode(params)
-            req = urllib.request.Request(url, data=data, method=method)
-            if content_type:
-                req.add_header("Content-Type", content_type)
-            try:
-                return urllib.request.urlopen(
-                    req, timeout=timeout or self.timeout,
-                    context=self._ssl)
-            except urllib.error.HTTPError as e:
-                body = e.read().decode()
+        backoff = None
+        for sweep in range(self.retries + 1):
+            for ep in self.endpoints:
+                url = ep + path
+                if params:
+                    url += "?" + urllib.parse.urlencode(params)
+                req = urllib.request.Request(url, data=data,
+                                             method=method)
+                if content_type:
+                    req.add_header("Content-Type", content_type)
                 try:
-                    parsed = json.loads(body)
-                except json.JSONDecodeError:
-                    parsed = body
-                raise ClientError(e.code, parsed) from None
-            except (urllib.error.URLError, OSError) as e:
-                last_err = e
-                continue
+                    return urllib.request.urlopen(
+                        req, timeout=timeout or self.timeout,
+                        context=self._ssl)
+                except urllib.error.HTTPError as e:
+                    body = e.read().decode()
+                    try:
+                        parsed = json.loads(body)
+                    except json.JSONDecodeError:
+                        parsed = body
+                    raise ClientError(e.code, parsed) from None
+                except (urllib.error.URLError, OSError) as e:
+                    last_err = e
+                    continue
+            if sweep < self.retries:
+                if backoff is None:
+                    backoff = Backoff(base=0.25, cap=5.0,
+                                      site="client")
+                time.sleep(backoff.next())
         raise last_err
 
     def _do(self, method: str, path: str, params: dict | None = None,

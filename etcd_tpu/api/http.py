@@ -20,6 +20,7 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..server import EtcdServer, gen_id
+from ..utils import faults as _faults
 from ..utils.errors import (
     ECODE_INDEX_NAN,
     ECODE_INVALID_FIELD,
@@ -244,6 +245,20 @@ class EtcdRequestHandler(BaseHTTPRequestHandler):
         path = urllib.parse.unquote(
             urllib.parse.urlsplit(self.path).path)
         try:
+            # surface-wide failpoint (PR 10): err answers 503,
+            # drop closes the connection without a byte, delay
+            # stalls the handler thread (a slow frontend)
+            try:
+                if self.mode == "peer":
+                    act = _faults.hit("http.peer")
+                else:
+                    act = _faults.hit("http.client")
+                if act == _faults.DROP:
+                    self.close_connection = True
+                    return
+            except OSError:
+                self._reply(503, b"injected fault\n")
+                return
             if self.mode == "peer":
                 if path == RAFT_PREFIX:
                     self._serve_raft(method)

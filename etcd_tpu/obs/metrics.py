@@ -274,6 +274,25 @@ _DEFS = (
         "batch through the fanout engine; empty sweeps are not "
         "observed).", buckets=SIZE_BUCKETS, window=2048),
     MetricDef(
+        "etcd_fault_injected_total", "counter",
+        "Fault-injection activations by failpoint and action "
+        "(utils/faults.py FAULT_CATALOG; actions err | enospc | "
+        "delay | drop | corrupt).  The nemesis drill's replay gate "
+        "compares these across seeded re-runs.",
+        labels=("point", "action")),
+    MetricDef(
+        "etcd_backoff_retries_total", "counter",
+        "Jittered-exponential backoff waits taken (utils/backoff), "
+        "by site: peerlink (pipe-channel reconnect pacing) | "
+        "snap_pull (streamed snapshot pull re-arm) | client (API "
+        "client endpoint-sweep failover) | nospace_probe (NOSPACE "
+        "recovery probe).", labels=("site",)),
+    MetricDef(
+        "etcd_nospace_active", "gauge",
+        "1 while this server is in read-only NOSPACE mode (ENOSPC "
+        "degradation: writes rejected with errorCode 405, reads "
+        "serve, recovery probes the disk with backoff), else 0."),
+    MetricDef(
         "etcd_lint_findings", "gauge",
         "Findings per checker in the last static-analysis run "
         "(baselined findings included; suppressed ones not).",

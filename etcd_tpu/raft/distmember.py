@@ -180,6 +180,20 @@ def _begin_campaign(state: GroupState, mask, slot):
         elapsed=jnp.where(mask, 0, state.elapsed)), mask, lterm
 
 
+@jax.jit
+def _step_down(state: GroupState, mask):
+    """Check-quorum abdication (PR 10): masked LEADER lanes become
+    followers with no known leader and a reset election timer.  The
+    term is untouched (the reference's checkQuorum stepDown —
+    raft.go becomeFollower(r.Term, None)): the deposed leader's
+    peers will elect at term+1 on their own timers."""
+    down = mask & (state.role == LEADER)
+    return state._replace(
+        role=jnp.where(down, FOLLOWER, state.role),
+        lead=jnp.where(down, -1, state.lead),
+        elapsed=jnp.where(down, 0, state.elapsed))
+
+
 @partial(jax.jit, static_argnames=("slot",))
 def _become_leader(state: GroupState, won, slot):
     """Winner lanes become leader (raft.go:329-348 batched); the
@@ -397,6 +411,19 @@ class DistMember:
         transport failure dropped in-flight frames (etcd raft
         becomeProbe): resend from the last CONFIRMED point."""
         self.state = progress_probe(self.state, self._full(peer))
+
+    def step_down(self, mask: np.ndarray) -> None:
+        """Abdicate the masked leader lanes (check-quorum, PR 10):
+        a leader whose outbound frames still deliver but whose
+        inbound acks are lost keeps the followers' election timers
+        reset FOREVER while never committing anything — the
+        asymmetric-partition wedge.  The server calls this when a
+        lane's quorum ack basis (the lease clock) has gone stale for
+        longer than the full worst-case election window: stop
+        heartbeating so the followers can elect a reachable
+        leader."""
+        self.state = _step_down(
+            self.state, self._put(np.asarray(mask, bool)))
 
     def handle_append_resp(self, r: AppendResp) -> np.ndarray:
         """Absorb a peer's batched response; returns the [G] commit

@@ -35,7 +35,6 @@ import json
 import logging
 import os
 import queue
-import random
 import threading
 import time
 import urllib.error
@@ -62,7 +61,9 @@ from ..snap.stream import (
     StaleSourceError,
 )
 from ..store import Store
-from ..utils.errors import EtcdError
+from ..utils import faults as _faults
+from ..utils.backoff import Backoff
+from ..utils.errors import EtcdError, EtcdNoSpace
 from ..utils.trace import tracer
 from ..utils.wait import Chan, Wait
 from ..wal import WAL, exist as wal_exist
@@ -112,6 +113,13 @@ _EXPIRED = object()  # pending read dropped by the expiry sweep
 K_ENTRY = 0      # a group's log entry
 K_FRONTIER = 1   # commit-frontier marker: [G] commit + [G] terms
 K_BALLOT = 2     # durable term/vote: [G] terms + [G] votes
+
+
+class FrameDropped(Exception):
+    """A peerlink.recv failpoint swallowed an inbound frame: the
+    handler closes the connection without a response — to the sender
+    this is a lost message (teardown + probe), to this host the
+    frame never arrived."""
 
 
 class _Pending:
@@ -230,8 +238,10 @@ class DistServer:
         # _need_pull and backs off with jittered exponential delay
         # across attempts (capped) instead of silently dropping the
         # request — the wedge the monolithic pull had.  Guarded by
-        # self.lock.
-        self._pull_backoff = 0.0     # current base delay (0 = fresh)
+        # self.lock.  Since PR 10 the shape lives in the shared
+        # utils/backoff.Backoff (site="snap_pull").
+        self._pull_backoff = Backoff(base=max(0.25, post_timeout),
+                                     cap=30.0, site="snap_pull")
         self._pull_not_before = 0.0  # monotonic gate for next attempt
         # per-donor store-size hints from the frontier probe: scales
         # the meta-fetch timeout with the blob the donor must
@@ -468,6 +478,39 @@ class DistServer:
             "etcd_read_rtt_seconds")
         self._read_ctrs: dict[tuple[str, str], object] = {}
 
+        # -- gray-failure semantics (PR 10) ---------------------------
+        # NOSPACE read-only mode: an EtcdNoSpace from any WAL/snap
+        # writer flips _nospace; writes are rejected with errorCode
+        # 405 while reads keep serving (leader lanes via the lease —
+        # heartbeats need no WAL), and the round loop probes the
+        # disk with backoff until space returns.  _held_recs carries
+        # leader-side WAL records whose entries are already in the
+        # engine log (frames may be in flight): they re-persist
+        # FIRST on recovery so the leader's own durable ack is never
+        # counted for an unpersisted entry.  Guarded by self.lock.
+        self._nospace = False
+        self._held_recs: list[Entry] | None = None
+        # precomputed failpoint link labels: the recv seam runs per
+        # pipelined ack and per inbound frame — two f-string
+        # allocations per crossing would tax the no-faults common
+        # case for nothing
+        self._self_label = f"s{slot}"
+        self._peer_labels = {p: f"s{p}" for p in range(self.m)}
+        self._nospace_backoff = Backoff(base=0.25, cap=5.0,
+                                        site="nospace_probe")
+        self._nospace_probe_t = 0.0
+        self._m_nospace = _obs.registry.gauge("etcd_nospace_active")
+        # Check-quorum step-down: a leader whose inbound acks are
+        # lost (one-way partition) must abdicate so its followers —
+        # whose timers its still-delivered heartbeats keep resetting
+        # — can elect a reachable leader.  A lane steps down when
+        # its quorum ack basis (lease clock) is older than the FULL
+        # worst-case election window, with a fresh-win grace
+        # (_lead_since, monotonic).
+        self._lead_since = np.zeros(g, np.float64)
+        self._down_s = 2.0 * (2 * max(election, self.m)) \
+            * tick_interval
+
         # -- tracing + flight recorder (PR 8) -------------------------
         # Per-server ring: in-process test clusters must not mix
         # three servers' events in one ring (the stitcher keys on the
@@ -475,6 +518,9 @@ class DistServer:
         # off), ETCD_FLIGHT_RING (capacity) and ETCD_TRACE_SLOW_MS
         # (tail-capture threshold) are read by the recorder.
         self.flight = FlightRecorder(node=self.name, slot=slot)
+        # fault activations land in this server's black box, and a
+        # fail-stop dumps the ring before the process exits
+        _faults.FAULTS.attach_sink(self.flight)
         # (group, gindex) -> trace_id for in-flight TRACED proposals
         # (sampled subset of _ack_clock's keys; guarded by self.lock)
         self._trace_live: dict[tuple[int, int], int] = {}
@@ -735,6 +781,7 @@ class DistServer:
         self._pool.close()
         self._ri_pool.close()
         self.store.fanout.close()
+        _faults.FAULTS.detach_sink(self.flight)
         # a deferred snapshot may still hold _snap_mutex mid-save;
         # join it before closing the WAL (its cut/gc would raise on
         # a closed file).  Same wedge rule as the round loop: if it
@@ -780,7 +827,20 @@ class DistServer:
         lockstep round did, and an unconditional hardstate+frontier
         fsync per iteration would turn idle loops into fsync storms
         (nothing new is durable-worthy when neither entries nor the
-        commit vector changed)."""
+        commit vector changed).
+
+        NOSPACE (PR 10): while the server is in read-only mode an
+        empty (frontier-only) save is SKIPPED — the frontier record
+        is an optimization (restart replays from an older frontier
+        and catches up), never worth failing for on a full disk.  A
+        save that DOES fail with ``EtcdNoSpace`` rolls this method's
+        own frontier seq allocation back (the WAL already rolled the
+        file back; caller-allocated record seqs are the caller's to
+        hold or roll back) and re-raises."""
+        if self._nospace and not ents:
+            return
+        seq0 = self.seq
+        fr0 = self._fr_last
         if frontier:
             commit = self.mr.commit_index().astype(np.int32)
             unchanged = (self._fr_last is not None
@@ -815,8 +875,13 @@ class DistServer:
                     kind=K_FRONTIER,
                     payload=commit.tobytes() + terms.tobytes())
                 .marshal())]
-        self.wal.save(HardState(term=self.raft_term, vote=0,
-                                commit=self.seq), ents)
+        try:
+            self.wal.save(HardState(term=self.raft_term, vote=0,
+                                    commit=self.seq), ents)
+        except EtcdNoSpace:
+            self.seq = seq0
+            self._fr_last = fr0
+            raise
 
     def _ballot_record(self) -> list[Entry]:
         """Allocate (seq-ordered) the ballot record for a changed
@@ -848,12 +913,20 @@ class DistServer:
     def _persist_ballot(self) -> None:
         """Durable term/vote BEFORE any vote or campaign leaves this
         host (the HardState analog, wal.go:35-39) — only when it
-        actually changed."""
+        actually changed.  ENOSPC rolls the allocation back and
+        re-raises: an unpersisted ballot must never back a vote."""
+        seq0 = self.seq
+        ballot0 = self._ballot
         rec = self._ballot_record()
         if rec:
-            self.wal.save(
-                HardState(term=self.raft_term, vote=0,
-                          commit=self.seq), rec)
+            try:
+                self.wal.save(
+                    HardState(term=self.raft_term, vote=0,
+                              commit=self.seq), rec)
+            except EtcdNoSpace:
+                self.seq = seq0
+                self._ballot = ballot0
+                raise
 
     def _entry_records(self, gis, base, items) -> list[Entry]:
         """WAL records for entries appended at this host."""
@@ -880,6 +953,21 @@ class DistServer:
         t_recv = time.monotonic()
         with tracer.stage("dist.frame_unmarshal"):
             msg = unmarshal_any(data)
+        # inbound half of an asymmetric partition (PR 10): the
+        # [src->dst]-qualified peerlink.recv failpoint — a dropped
+        # frame never touches engine state and gets NO response (the
+        # handler closes the connection; to the sender it is a lost
+        # message)
+        sender = getattr(msg, "sender", None)
+        try:
+            act = _faults.hit(
+                "peerlink.recv",
+                src=self._peer_labels.get(sender),
+                dst=self._self_label)
+        except OSError as e:
+            raise FrameDropped() from e
+        if act == _faults.DROP:
+            raise FrameDropped()
         traced = (isinstance(msg, AppendBatch) and msg.trace) or None
         if traced:
             # the receive edge of the stitcher's clock-alignment
@@ -896,6 +984,15 @@ class DistServer:
                 # 503; the sender treats it as transport failure and
                 # probes on reconnect)
                 raise ServerStoppedError()
+            if self._nospace:
+                # read-only: appended entries could not be persisted
+                # and votes could not record a durable ballot — both
+                # are refused BEFORE any engine mutation (the
+                # handler answers 507; the sender probes and the
+                # at-least-once redelivery rebuilds everything once
+                # space returns)
+                raise EtcdNoSpace(
+                    cause="member is read-only (NOSPACE)")
             if isinstance(msg, AppendBatch):
                 self.server_stats.recv_append()
                 with tracer.stage("dist.handle_append"), \
@@ -907,6 +1004,8 @@ class DistServer:
                 # carries ballot + entries (a later seq on disk
                 # before earlier ones reads as an index gap on the
                 # next restart — found by the chaos drill)
+                seq0 = self.seq
+                ballot0 = self._ballot
                 with tracer.stage("dist.frame_records"):
                     recs = self._ballot_record()
                     for gi in np.nonzero(resp.appended)[0]:
@@ -921,8 +1020,21 @@ class DistServer:
                                     gterm=int(msg.ent_terms[gi, j]),
                                     payload=msg.payloads[gi][j])
                                 .marshal()))
-                with tracer.stage("dist.frame_persist"):
-                    self._persist(recs)
+                try:
+                    with tracer.stage("dist.frame_persist"):
+                        self._persist(recs)
+                except EtcdNoSpace:
+                    # full disk mid-frame: the engine appended but
+                    # nothing hit the WAL (file rolled back).  Roll
+                    # the seq/ballot allocations back, go read-only,
+                    # and give the sender NO ack — its at-least-once
+                    # redelivery re-persists these entries after
+                    # recovery (duplicate engine appends are no-ops,
+                    # duplicate WAL records dedup at replay).
+                    self.seq = seq0
+                    self._ballot = ballot0
+                    self._enter_nospace("handle_frame persist")
+                    raise
                 if traced:
                     # one fsync covered the whole batch: every traced
                     # entry whose lane actually appended is durable
@@ -958,7 +1070,15 @@ class DistServer:
                 return out
             if isinstance(msg, VoteReq):
                 resp = self.mr.handle_vote(msg)
-                self._persist_ballot()
+                try:
+                    self._persist_ballot()
+                except EtcdNoSpace:
+                    # the grant is NOT durable: never send it (a
+                    # vote that could be forgotten across a restart
+                    # is a double-vote waiting to happen) — go
+                    # read-only and give the candidate nothing
+                    self._enter_nospace("vote persist")
+                    raise
                 return resp.marshal()
         raise ValueError(f"unhandled frame {type(msg).__name__}")
 
@@ -1048,6 +1168,17 @@ class DistServer:
         if not (0 <= k < src.n_chunks):
             return 416, b""
         data = src.chunk(k)
+        # donor-side failpoint (PR 10): the generalized form of the
+        # one-shot env corruption hook below
+        try:
+            act = _faults.hit("snapstream.serve",
+                              src=f"s{self.slot}")
+        except OSError:
+            return 500, b""
+        if act == _faults.DROP:
+            return 503, b""
+        if act == _faults.CORRUPT:
+            data = _faults.flip_byte(data)
         if k == self._corrupt_chunk and not self._corrupted_once:
             # test hook: one corrupted serve, then clean — the
             # receiver must reject on the rolling CRC and refetch
@@ -1072,6 +1203,13 @@ class DistServer:
         the write-side validation both do() and do_many() decode."""
         if r.id == 0:
             return "err", ValueError("r.id cannot be 0")
+        if self._nospace:
+            # NOSPACE read-only mode: every write (including a
+            # would-be forward — this member's replica cannot apply
+            # while it refuses frames, so read-your-write through it
+            # would dangle) is rejected with the distinct code
+            return "err", EtcdNoSpace(
+                cause="member is read-only (NOSPACE)")
         if r.method == "GET" and r.quorum:
             r.method = "QGET"
         if r.method not in self._WRITE_METHODS:
@@ -1673,7 +1811,7 @@ class DistServer:
                 # interleaving vs OTHER groups' writes can differ per
                 # host by up to one sync interval — the co-hosted
                 # server documents the same class of divergence.)
-                if self.mr.is_leader()[0]:
+                if self.mr.is_leader()[0] and not self._nospace:
                     r = Request(method="SYNC", id=gen_id(),
                                 time=int(time.time() * 1e9))
                     self._queue.put(_Pending(req=r, data=r.marshal(),
@@ -1703,6 +1841,9 @@ class DistServer:
                     # lanes that fire lost their leader
                 if fire.any():
                     self._campaign(fire)
+            if self._nospace \
+                    and time.monotonic() >= self._nospace_probe_t:
+                self._nospace_recover()
             with self.lock:
                 # handle_frame sets the flag under the lock; an
                 # unlocked test-and-clear here could lose a pull
@@ -1837,7 +1978,45 @@ class DistServer:
         (``mr.ack_self``) — commit still requires a quorum of DURABLE
         copies, they just become durable in parallel now."""
         mr = self.mr
+        if self._nospace:
+            # read-only: reject the drained batch AND anything
+            # requeued with the typed code (waiters get a decodable
+            # EtcdNoSpace, never a silent timeout; proposing would
+            # only grow the engine log with entries the WAL cannot
+            # take)
+            err = EtcdNoSpace(cause="member is read-only (NOSPACE)")
+            for p in batch:
+                self.w.trigger(p.id, Response(err=err))
+            batch = []
+            for q in self._requeue:
+                while q:
+                    self.w.trigger(q.popleft().id,
+                                   Response(err=err))
         with self.lock:
+            now_m = time.monotonic()
+            if self._prev_lead.any():
+                # check-quorum step-down (PR 10): a lane whose
+                # quorum ack basis is older than the FULL worst-case
+                # election window cannot be committing anything, yet
+                # its outbound heartbeats may still be muzzling the
+                # followers' timers (one-way partition).  Abdicate
+                # so a reachable leader can be elected; the normal
+                # lost_lead machinery below observes the transition.
+                basis = self.lease.basis(self._members_np,
+                                         self._nmembers_np, now_m)
+                stale = self._prev_lead & (
+                    np.maximum(basis, self._lead_since)
+                    < now_m - self._down_s)
+                if stale.any():
+                    mr.step_down(stale)
+                    self.flight.record(
+                        "step_down", lanes=int(stale.sum()),
+                        first=np.nonzero(stale)[0][:8].tolist(),
+                        cause="check_quorum")
+                    log.warning(
+                        "dist[%d]: check-quorum step-down on %d "
+                        "lane(s): no quorum ack for %.1fs",
+                        self.slot, int(stale.sum()), self._down_s)
             # backstop: a frame whose ack AND failure were both lost
             # (transport edge cases) must not pin the window shut
             expired = self.pipe.expire(time.monotonic(),
@@ -1896,6 +2075,11 @@ class DistServer:
                 for pr in self._reads.fail_lanes(lost_lead):
                     pr.ch.close(None)
             if won.any():
+                # fresh-win grace for the check-quorum sweep: the
+                # first acks take an RTT to arrive, and a basis of 0
+                # must not read as "stale for ages"
+                self._lead_since = np.where(won, now_m,
+                                            self._lead_since)
                 now_w = time.time()
                 terms = mr.terms()
                 for gi in np.nonzero(won)[0]:
@@ -2001,26 +2185,46 @@ class DistServer:
                 # counts; the overlap ledger row makes the saved wall
                 # time readable off /metrics (dispatch_seconds =
                 # fsync seconds that ran with frames in flight)
-                if self.pipe.inflight_total():
-                    with tracer.stage("dist.persist"), \
-                            _ledger.dispatch("dist.fsync_overlap"):
-                        self._persist(recs)
-                else:
-                    with tracer.stage("dist.persist"):
-                        self._persist(recs)
-                # fsync landed: NOW this host's copy joins the quorum
-                mr.ack_self(np.asarray(mr.state.last))
-                if self._trace_live and new_keys:
-                    now_f = time.monotonic()
-                    for key in new_keys:
-                        tid = self._trace_live.get(key)
-                        if tid is not None:
-                            self.flight.span(tid, self.slot,
-                                             "leader_fsync", t=now_f)
+                try:
+                    if self.pipe.inflight_total():
+                        with tracer.stage("dist.persist"), \
+                                _ledger.dispatch("dist.fsync_overlap"):
+                            self._persist(recs)
+                    else:
+                        with tracer.stage("dist.persist"):
+                            self._persist(recs)
+                except EtcdNoSpace:
+                    # full disk under a leader: the entries are in
+                    # the engine log and their frames may already be
+                    # in flight (fsync/network overlap) — HOLD the
+                    # records for re-persist at recovery and do NOT
+                    # self-ack (commit may still form from a quorum
+                    # of FOLLOWER acks, which is legal Raft: the
+                    # entry is durable elsewhere).  New writes are
+                    # refused from here on.
+                    self._enter_nospace("leader persist", held=recs)
+                    recs = []
+                if recs:
+                    # fsync landed: NOW this host's copy joins the
+                    # quorum
+                    mr.ack_self(np.asarray(mr.state.last))
+                    if self._trace_live and new_keys:
+                        now_f = time.monotonic()
+                        for key in new_keys:
+                            tid = self._trace_live.get(key)
+                            if tid is not None:
+                                self.flight.span(tid, self.slot,
+                                                 "leader_fsync",
+                                                 t=now_f)
             else:
                 # nothing appended here, but acks may have moved the
                 # commit frontier since the last flush
-                self._persist([])
+                try:
+                    self._persist([])
+                except EtcdNoSpace:
+                    # the frontier record is an optimization —
+                    # losing it costs replay time, never acked data
+                    self._enter_nospace("frontier persist")
             with tracer.stage("dist.apply"):
                 self._apply_committed(self._assigned)
             # read maintenance: drop waiters whose callers timed out
@@ -2055,7 +2259,8 @@ class DistServer:
                     self._on_pipe_fail(_p, seqs, reason),
                 on_sent=lambda seq, _p=peer:
                     self._on_pipe_sent(_p, seq),
-                name=f"{self.slot}to{peer}")
+                name=f"{self.slot}to{peer}",
+                fault_ctx=(f"s{self.slot}", f"s{peer}"))
             self._channels[peer] = chan
         return chan
 
@@ -2199,6 +2404,19 @@ class DistServer:
         """Channel reader callback: one ack arrived."""
         if self.done.is_set():
             return
+        # inbound half of the peerlink.recv failpoint: a dropped ack
+        # simply evaporates — no progress, no failure signal — and
+        # only the in-flight expire sweep recovers the window (the
+        # asymmetric-partition case check-quorum step-down exists
+        # for)
+        try:
+            act = _faults.hit("peerlink.recv",
+                              src=self._peer_labels[peer],
+                              dst=self._self_label)
+        except OSError:
+            act = _faults.DROP
+        if act == _faults.DROP:
+            return
         if status != 200:
             self._on_pipe_fail(peer, [seq], "reconnect")
             return
@@ -2322,9 +2540,19 @@ class DistServer:
 
     def _campaign(self, mask: np.ndarray) -> None:
         """Batched election round-trip for the fired lanes."""
+        if self._nospace:
+            # cannot durably record term/vote: campaigning (or
+            # tallying a win whose becoming-leader entry can't
+            # persist) is off the table until space returns
+            return
         with self.lock:
             req = self.mr.begin_campaign(mask)
-            self._persist_ballot()
+            try:
+                self._persist_ballot()
+            except EtcdNoSpace:
+                # an un-durable self-vote must not leave the host
+                self._enter_nospace("campaign ballot")
+                return
             payload = req.marshal()
             self._m_campaigns.inc(
                 int(np.asarray(req.active).sum()))
@@ -2346,7 +2574,11 @@ class DistServer:
                 won=int(won.sum()), resps=len(votes),
                 term=int(np.asarray(self.mr.state.term).max()),
                 lanes=np.nonzero(fired)[0][:8].tolist())
-            self._persist_ballot()
+            try:
+                self._persist_ballot()
+            except EtcdNoSpace:
+                self._enter_nospace("tally ballot")
+                return
             lost = int(np.asarray(req.active).sum()) \
                 - int(won.sum())
             if lost and self._debug_elections:
@@ -2379,7 +2611,15 @@ class DistServer:
                             kind=K_ENTRY, group=int(gi),
                             gindex=int(base[gi]) + 1,
                             gterm=int(terms[gi])).marshal()))
-                self._persist(recs)
+                try:
+                    self._persist(recs)
+                except EtcdNoSpace:
+                    # the becoming-leader entries live in the engine
+                    # log with frames about to pump: hold their
+                    # records for recovery, same as the leader-round
+                    # persist
+                    self._enter_nospace("campaign persist",
+                                        held=recs)
 
     def _exchange(self, frames: list[tuple[int, bytes]],
                   track: bool = False) -> list:
@@ -2451,10 +2691,26 @@ class DistServer:
         classic sender; at-least-once delivery contract and the
         URL-change/stale-socket handling live there).  Used by the
         vote round-trips; append frames ride the pipelined channels
-        instead."""
+        instead.  Both directions cross the peerlink failpoints
+        (PR 10): a dropped send or a dropped response is a dropped
+        message — by contract, recovered by retry."""
+        try:
+            if _faults.hit("peerlink.send", src=self._self_label,
+                           dst=self._peer_labels[peer]) \
+                    == _faults.DROP:
+                return None
+        except OSError:
+            return None
         out = self._pool.post(peer, self.peer_urls[peer], path,
                               payload)
         if out is None or out[0] != 200:
+            return None
+        try:
+            if _faults.hit("peerlink.recv",
+                           src=self._peer_labels[peer],
+                           dst=self._self_label) == _faults.DROP:
+                return None
+        except OSError:
             return None
         return out[1]
 
@@ -2556,6 +2812,62 @@ class DistServer:
                     and self.applied[gi] > self._applied_at_elect[gi]):
                 self._first_apply_at[gi] = time.time()
 
+    # -- NOSPACE read-only mode (PR 10) -----------------------------------
+
+    def _enter_nospace(self, cause: str,
+                       held: list[Entry] | None = None) -> None:
+        """Flip into read-only mode (call with self.lock held).
+        ``held`` carries leader-side WAL records whose entries are
+        already in the engine log — they re-persist FIRST at
+        recovery, before this host's durable self-ack counts."""
+        if held:
+            self._held_recs = (self._held_recs or []) + held
+        if self._nospace:
+            return
+        self._nospace = True
+        self._nospace_backoff.reset()
+        self._nospace_probe_t = (time.monotonic()
+                                 + self._nospace_backoff.next())
+        self._m_nospace.set(1)
+        self.flight.record("nospace", state="enter", cause=cause)
+        log.error("dist[%d]: ENTERING NOSPACE read-only mode (%s): "
+                  "writes rejected with errorCode 405, reads keep "
+                  "serving, disk probed with backoff", self.slot,
+                  cause)
+
+    def _exit_nospace(self) -> None:
+        """Leave read-only mode (call with self.lock held)."""
+        if not self._nospace:
+            return
+        self._nospace = False
+        self._nospace_backoff.reset()
+        self._m_nospace.set(0)
+        # force the next _persist to write a fresh frontier record
+        # (frontier saves were skipped throughout the episode)
+        self._fr_last = None
+        self.flight.record("nospace", state="exit")
+        log.warning("dist[%d]: NOSPACE recovered — accepting writes "
+                    "again", self.slot)
+
+    def _nospace_recover(self) -> None:
+        """Round-loop recovery probe: exercise the WAL's append +
+        fsync seams; on success re-persist any held leader records
+        (their entries were never self-acked) and re-open for
+        writes.  Failure re-arms the probe with the shared
+        backoff — a full disk is polled, never crash-looped."""
+        try:
+            with self.lock:
+                self.wal.probe_space()
+                if self._held_recs:
+                    self._persist(self._held_recs)
+                    self._held_recs = None
+                    self.mr.ack_self(np.asarray(self.mr.state.last))
+                self._exit_nospace()
+        except EtcdNoSpace:
+            delay = self._nospace_backoff.next()
+            with self.lock:
+                self._nospace_probe_t = time.monotonic() + delay
+
     # -- snapshot / catch-up ----------------------------------------------
 
     def snapshot(self) -> None:
@@ -2578,35 +2890,47 @@ class DistServer:
         runs OUTSIDE it, so peer frames and client ops don't stall
         behind snapshot disk I/O; ``_snap_mutex`` serializes
         concurrent snapshot() calls instead."""
-        with self._snap_mutex:
-            with self.lock:
-                snap_seq = self.seq
-                # only the tree->dict capture (store.save) needs the
-                # lock; the outer dumps re-escapes the whole embedded
-                # store string — comparable cost again — and must not
-                # stall handlers/round loop for it
-                d = self._snapshot_dict()
-                term = self.raft_term
-            blob = json.dumps(d).encode()
-            with tracer.stage("dist.snapshot"):
-                # only this process's snapshot() writes the snap dir,
-                # and _snap_mutex is held: safe outside self.lock
-                self.ss.save_snap(Snapshot(
-                    data=blob, index=snap_seq, term=term))
+        try:
+            with self._snap_mutex:
                 with self.lock:
-                    self.mr.compact()
-                    if log.isEnabledFor(logging.DEBUG):
-                        log.debug(
-                            "dist[%d]: post-compact offset=%s "
-                            "applied=%s lead=%s", self.slot,
-                            np.asarray(self.mr.state.offset).tolist(),
-                            np.asarray(self.mr.state.applied).tolist(),
-                            np.asarray(self.mr.is_leader())
-                            .astype(int).tolist())
-                    self.wal.cut()
-                    floor = self.ss.retained_floor()
-                    self.wal.gc(snap_seq if floor is None else floor)
-            self._snapi = self.raft_index
+                    snap_seq = self.seq
+                    # only the tree->dict capture (store.save) needs
+                    # the lock; the outer dumps re-escapes the whole
+                    # embedded store string — comparable cost again —
+                    # and must not stall handlers/round loop for it
+                    d = self._snapshot_dict()
+                    term = self.raft_term
+                blob = json.dumps(d).encode()
+                with tracer.stage("dist.snapshot"):
+                    # only this process's snapshot() writes the snap
+                    # dir, and _snap_mutex is held: safe outside
+                    # self.lock
+                    self.ss.save_snap(Snapshot(
+                        data=blob, index=snap_seq, term=term))
+                    with self.lock:
+                        self.mr.compact()
+                        if log.isEnabledFor(logging.DEBUG):
+                            log.debug(
+                                "dist[%d]: post-compact offset=%s "
+                                "applied=%s lead=%s", self.slot,
+                                np.asarray(
+                                    self.mr.state.offset).tolist(),
+                                np.asarray(
+                                    self.mr.state.applied).tolist(),
+                                np.asarray(self.mr.is_leader())
+                                .astype(int).tolist())
+                        self.wal.cut()
+                        floor = self.ss.retained_floor()
+                        self.wal.gc(snap_seq if floor is None
+                                    else floor)
+                self._snapi = self.raft_index
+        except EtcdNoSpace as e:
+            # snapshot save / WAL cut hit a full disk: the one state
+            # GC could have shrunk keeps growing, so degrade to
+            # read-only instead of crash-looping the snapshot thread
+            with self.lock:
+                self._enter_nospace(f"snapshot: {e.cause}")
+            return
         log.info("dist[%d]: snapshot at seq=%d", self.slot, snap_seq)
 
     def _snapshot_bg(self) -> None:
@@ -2654,10 +2978,7 @@ class DistServer:
         unrelated need_snap frame happened to re-trigger it)."""
         with self.lock:
             self._need_pull = True
-            base = max(0.25, self.post_timeout)
-            self._pull_backoff = min(
-                30.0, self._pull_backoff * 2 or base)
-            delay = self._pull_backoff * random.uniform(0.5, 1.5)
+            delay = self._pull_backoff.next()
             self._pull_not_before = time.monotonic() + delay
         log.info("dist[%d]: snapshot pull failed on every donor; "
                  "retrying in %.2fs", self.slot, delay)
@@ -2861,7 +3182,13 @@ class DistServer:
                                                self.raft_index)
                     self.raft_term = max(self.raft_term,
                                          int(terms.max()))
-                    self._persist([])
+                    try:
+                        self._persist([])
+                    except EtcdNoSpace:
+                        # the install is in-memory state; a member
+                        # that restarts before space returns simply
+                        # re-pulls (need_snap re-fires)
+                        self._enter_nospace("install persist")
                     # the installed frontier may cover parked
                     # follower reads, and the snapshot's membership
                     # feeds the read path's quorum math
@@ -2869,7 +3196,7 @@ class DistServer:
                     if self._waits.pending:
                         for ch in self._waits.release(self.applied):
                             ch.close(True)
-                    self._pull_backoff = 0.0
+                    self._pull_backoff.reset()
                     self._pull_not_before = 0.0
                     log.info("dist[%d]: installed streamed snapshot "
                              "from host %d (%d lanes, %d bytes)",
@@ -3011,11 +3338,55 @@ def _make_peer_handler(server: DistServer):
 
         def do_POST(self):
             try:
+                if self.path == "/mraft/faults":
+                    # runtime fault control (PR 10): the nemesis
+                    # drill arms and clears failpoint specs mid-run.
+                    # Routed BEFORE the http.peer failpoint below —
+                    # an armed http.peer drop must never lock out
+                    # its own clear path.  Body: {"spec": "...",
+                    # "seed": N}; empty spec clears.  A bad spec is
+                    # a loud 400 — a typo'd failpoint must never
+                    # silently inject nothing.
+                    try:
+                        d = json.loads(self._body() or b"{}")
+                        _faults.FAULTS.configure(
+                            d.get("spec", ""), seed=d.get("seed"))
+                        self._reply(200, json.dumps(
+                            {"ok": True,
+                             "spec": _faults.FAULTS.spec}).encode())
+                    except (_faults.FaultSpecError, ValueError,
+                            TypeError) as e:
+                        self._reply(400, json.dumps(
+                            {"ok": False,
+                             "message": str(e)}).encode())
+                    return
+                # http.peer failpoint: whole-surface delay / error /
+                # connection drop for the peer tier
+                try:
+                    if _faults.hit("http.peer") == _faults.DROP:
+                        self.close_connection = True
+                        return
+                except OSError:
+                    self._reply(503, b"")
+                    return
                 if self.path == "/mraft":
                     try:
                         out = server.handle_frame(self._body())
                     except ServerStoppedError:
                         self._reply(503, b"")
+                        return
+                    except EtcdNoSpace:
+                        # read-only member: a distinct status the
+                        # sender reads as "frame refused" (teardown
+                        # + probe), distinct from the stopping 503
+                        # in the logs
+                        self._reply(507, b"")
+                        return
+                    except FrameDropped:
+                        # injected inbound loss: no response at all —
+                        # the sender sees a dead connection, exactly
+                        # like a lost frame
+                        self.close_connection = True
                         return
                     self._reply(200, out)
                 elif self.path == SNAP_META_PATH:
@@ -3141,7 +3512,12 @@ def _make_peer_handler(server: DistServer):
                     pass
 
         def do_GET(self):
-            if self.path == "/mraft/snapshot":
+            if self.path == "/mraft/faults":
+                # active spec + per-(point, action) injection counts
+                # (the nemesis replay gate compares these)
+                self._reply(200, json.dumps(
+                    _faults.FAULTS.snapshot()).encode())
+            elif self.path == "/mraft/snapshot":
                 self._reply(200, server.snapshot_blob())
             elif self.path == SNAP_FRONTIER_PATH:
                 self._reply(200, server.snapshot_frontier())

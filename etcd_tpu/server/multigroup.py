@@ -48,6 +48,8 @@ import numpy as np
 from ..obs import metrics as _obs
 from ..snap import NoSnapshotError, Snapshotter
 from ..store import Store
+from ..utils.backoff import Backoff
+from ..utils.errors import EtcdNoSpace
 from ..utils.trace import maybe_start_jax_profile, tracer
 from ..utils.wait import Wait
 from ..wal import WAL, exist as wal_exist
@@ -178,6 +180,18 @@ class MultiGroupServer:
         self.raft_index = 0               # applied entries total
         self.raft_term = 0
         self._snapi = 0                   # raft_index at last snapshot
+        # NOSPACE read-only mode (PR 10): a persist that hits
+        # EtcdNoSpace HOLDS its (assigned, ents, hardstate) batch —
+        # applies and client acks wait behind the held persist,
+        # which retries at probe cadence; meanwhile writes are
+        # rejected with errorCode 405 and reads keep serving off the
+        # shared store.
+        self._nospace = False
+        self._held: tuple | None = None
+        self._nospace_backoff = Backoff(base=0.25, cap=5.0,
+                                        site="nospace_probe")
+        self._nospace_probe_t = 0.0
+        self._m_nospace = _obs.registry.gauge("etcd_nospace_active")
 
         if wal_exist(self._waldir):
             self._restart(cap, max_batch_ents)
@@ -425,6 +439,11 @@ class MultiGroupServer:
         if r.method == "GET" and r.quorum:
             r.method = "QGET"
         if r.method in ("POST", "PUT", "DELETE", "QGET"):
+            if self._nospace:
+                # read-only NOSPACE mode: the distinct error code
+                # (reads below keep serving the shared store)
+                raise EtcdNoSpace(
+                    cause="member is read-only (NOSPACE)")
             ch = self.w.register(r.id)
             self._queue.put(_Pending(req=r, data=r.marshal(), id=r.id))
             try:
@@ -541,6 +560,22 @@ class MultiGroupServer:
             if self.done.is_set():
                 break
             now = time.monotonic()
+            if self._nospace:
+                # read-only: reject queued writes with the typed
+                # code, retry the held persist at probe cadence,
+                # and propose nothing new (the engine log must not
+                # outgrow a WAL that cannot take records)
+                err = EtcdNoSpace(
+                    cause="member is read-only (NOSPACE)")
+                for p in batch:
+                    self.w.trigger(p.id, Response(err=err))
+                for q in self._requeue:
+                    while q:
+                        self.w.trigger(q.popleft().id,
+                                       Response(err=err))
+                if now >= self._nospace_probe_t:
+                    self._nospace_recover()
+                continue
             if now >= next_tick:
                 if (mr.leader < 0).any():
                     self._campaign_and_fence(mr.leader < 0)
@@ -658,6 +693,10 @@ class MultiGroupServer:
         frontier go to the WAL (fsync) BEFORE any client ack — the
         Ready contract's ordering (node.go:41-60) at batch level."""
         mr = self.mr
+        if self._nospace:
+            # applies and acks queue behind the held persist; the
+            # recovery path re-runs this once the save lands
+            return
         commit = mr.commit_index().astype(np.int64)
         newly = commit > self.applied
         if to_persist or newly.any():
@@ -677,9 +716,21 @@ class MultiGroupServer:
             ents = (to_persist or []) + [
                 Entry(index=self.seq, term=self.raft_term,
                       data=frontier)]
-            with tracer.stage("mg.persist"):
-                self.wal.save(HardState(term=self.raft_term, vote=0,
-                                        commit=self.seq), ents)
+            hs = HardState(term=self.raft_term, vote=0,
+                           commit=self.seq)
+            try:
+                with tracer.stage("mg.persist"):
+                    self.wal.save(hs, ents)
+            except EtcdNoSpace as e:
+                # full disk: HOLD the batch (seqs stay allocated —
+                # the WAL rolled its file back, so re-writing the
+                # same records at recovery is seq-contiguous) and go
+                # read-only.  Nothing applies and nothing acks until
+                # the save lands: the Ready-contract ordering is
+                # preserved by simply not advancing.
+                self._held = (dict(assigned), ents, hs)
+                self._enter_nospace(e)
+                return
 
         if not newly.any():
             return
@@ -692,7 +743,55 @@ class MultiGroupServer:
         mr.mark_applied(self.applied)
 
         if self.raft_index - self._snapi > self.snap_count:
-            self.snapshot()
+            try:
+                self.snapshot()
+            except EtcdNoSpace as e:
+                # snapshot save / cut hit a full disk: degrade to
+                # read-only (the trigger re-fires after recovery)
+                self._enter_nospace(e)
+
+    # -- NOSPACE read-only mode (PR 10) -----------------------------------
+
+    def _enter_nospace(self, e: EtcdNoSpace) -> None:
+        if not self._nospace:
+            self._nospace = True
+            self._nospace_backoff.reset()
+            self._m_nospace.set(1)
+            log.error("multigroup: ENTERING NOSPACE read-only mode "
+                      "(%s): writes rejected with errorCode 405, "
+                      "reads keep serving", e.cause)
+        self._nospace_probe_t = (time.monotonic()
+                                 + self._nospace_backoff.next())
+
+    def _exit_nospace(self) -> None:
+        if self._nospace:
+            self._nospace = False
+            self._nospace_backoff.reset()
+            self._m_nospace.set(0)
+            log.warning("multigroup: NOSPACE recovered — accepting "
+                        "writes again")
+
+    def _nospace_recover(self) -> None:
+        """Run-loop probe: re-persist the held batch (same seqs —
+        the WAL rolled its file back to the pre-batch mark), then
+        apply + ack it; without a held batch just probe the disk."""
+        try:
+            held = self._held
+            if held is not None:
+                assigned, ents, hs = held
+                with tracer.stage("mg.persist"):
+                    self.wal.save(hs, ents)
+                self._held = None
+                self._exit_nospace()
+                # applies + client acks ride the normal absorb path
+                # now that the records are durable
+                self._absorb_commits(assigned)
+            else:
+                self.wal.probe_space()
+                self._exit_nospace()
+        except EtcdNoSpace:
+            self._nospace_probe_t = (time.monotonic()
+                                     + self._nospace_backoff.next())
 
     def _apply_newly(self, assigned, commit, newly) -> None:
         mr = self.mr

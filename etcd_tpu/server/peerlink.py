@@ -28,6 +28,9 @@ import threading
 from collections import deque
 from urllib.parse import urlparse
 
+from ..utils import faults as _faults
+from ..utils.backoff import Backoff
+
 log = logging.getLogger(__name__)
 
 
@@ -163,7 +166,8 @@ class _Stripe:
     """One pipelined socket: requests written ahead, responses read
     back in order and FIFO-matched to their seq tags."""
 
-    __slots__ = ("sock", "rf", "pending", "cond", "gen", "dead", "q")
+    __slots__ = ("sock", "rf", "pending", "cond", "gen", "dead", "q",
+                 "backoff")
 
     def __init__(self):
         self.sock = None
@@ -173,6 +177,15 @@ class _Stripe:
         self.gen = 0      # bumped per (re)connect
         self.dead = True
         self.q: queue.Queue = queue.Queue()
+        # reconnect pacing (PR 10): the first retry after a healthy
+        # stretch is free, then jittered-exponential up to 5s.
+        # Reset ONLY when the reader parses a real response — under
+        # a persistent one-way partition connect() keeps succeeding
+        # while responses never come, and the old flat 50ms wait
+        # became a tight connect/teardown churn loop at read_timeout
+        # cadence.
+        self.backoff = Backoff(base=0.05, cap=5.0, site="peerlink",
+                               first_zero=True)
 
 
 class PipeChannel:
@@ -208,12 +221,20 @@ class PipeChannel:
     the frame BEFORE queueing it, but the writer may drain later
     under load; stamping at registration would fold queue wait into
     the network hop).
+
+    ``fault_ctx=(src, dst)`` (optional) names the link for the
+    ``peerlink.send`` failpoint (utils/faults): ``drop`` loses the
+    frame SILENTLY — not registered as pending, no on_fail — so only
+    the caller's in-flight expire sweep recovers it (the gray-loss
+    case the sweep exists for); ``corrupt`` flips one payload byte;
+    ``err`` reads as a send failure.
     """
 
     def __init__(self, url: str, path: str, *, stripes: int = 1,
                  timeout: float = 1.0, read_timeout: float | None = None,
                  ssl_context=None, on_resp=None, on_fail=None,
-                 on_sent=None, name: str = ""):
+                 on_sent=None, name: str = "",
+                 fault_ctx: tuple[str, str] | None = None):
         self.url = url
         u = urlparse(url)
         self._host, self._port = u.hostname, u.port
@@ -228,6 +249,7 @@ class PipeChannel:
         self._on_resp = on_resp or (lambda seq, status, body: None)
         self._on_fail = on_fail or (lambda seqs, reason: None)
         self._on_sent = on_sent
+        self._fault_ctx = fault_ctx or (None, None)
         self._closed = threading.Event()
         self.stripes = max(1, stripes)
         self._stripes = [_Stripe() for _ in range(self.stripes)]
@@ -336,11 +358,37 @@ class PipeChannel:
                 self._on_fail([item[0]], "closed")
                 return
             seq, payload = item
-            if st.dead and not self._connect(st):
-                self._on_fail([seq], "reconnect")
-                # dead peer: don't hot-spin the connect syscall
-                self._closed.wait(0.05)
+            # peerlink.send failpoint (PR 10): silent loss / byte
+            # corruption / injected send error, per [src->dst]
+            try:
+                act = _faults.hit("peerlink.send",
+                                  src=self._fault_ctx[0],
+                                  dst=self._fault_ctx[1])
+            except OSError:
+                self._on_fail([seq], "fault")
                 continue
+            if act == _faults.DROP:
+                # SILENT loss: never registered as pending, no
+                # on_fail — exactly the gray failure the caller's
+                # expire sweep exists to recover
+                continue
+            if act == _faults.CORRUPT:
+                payload = _faults.flip_byte(payload)
+            if st.dead:
+                # reconnect pacing (shared jittered backoff): one
+                # free immediate retry after a healthy stretch, then
+                # exponential — reset only by a parsed response, so
+                # a one-way partition (connect works, responses
+                # never come) cannot hot-loop connect/teardown
+                d = st.backoff.next()
+                if d > 0:
+                    self._closed.wait(d)
+                    if self._closed.is_set():
+                        self._on_fail([seq], "closed")
+                        return
+                if not self._connect(st):
+                    self._on_fail([seq], "reconnect")
+                    continue
             head = (f"POST {self._path} HTTP/1.1\r\n"
                     f"Host: {self._host}:{self._port}\r\n"
                     f"Content-Type: application/octet-stream\r\n"
@@ -385,6 +433,9 @@ class PipeChannel:
             except (OSError, ValueError, ConnectionError):
                 self._teardown(st, "reconnect", gen=gen)
                 continue
+            # a real response arrived: the link is healthy — re-arm
+            # the writer's reconnect pacing from zero
+            st.backoff.reset()
             with st.cond:
                 if st.gen != gen:
                     continue  # raced a teardown; seqs already failed

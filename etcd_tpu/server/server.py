@@ -23,7 +23,8 @@ from typing import Callable, Optional
 from ..raft import Node, Peer, STATE_LEADER, restart_node, start_node
 from ..snap import NoSnapshotError, Snapshotter
 from ..store import Store, Watcher
-from ..utils.errors import EtcdError
+from ..utils.backoff import Backoff
+from ..utils.errors import EtcdError, EtcdNoSpace
 from ..utils.trace import tracer
 from ..utils.wait import Wait
 from ..wal import WAL, TornTailError, exist as wal_exist
@@ -149,6 +150,11 @@ class WalSnapStorage:
     def cut(self) -> None:
         self.wal.cut()
 
+    def probe_space(self) -> None:
+        """NOSPACE recovery probe (PR 10): raises EtcdNoSpace while
+        the disk still refuses."""
+        self.wal.probe_space()
+
     def gc(self, index: int) -> int:
         """Segment GC behind the DURABLE snapshot window (PR 6): the
         run loop calls this right after ``save_snap`` returns — the
@@ -189,6 +195,19 @@ class EtcdServer:
         self._publish_thread: threading.Thread | None = None
         self.raft_index = 0
         self.raft_term = 0
+        # NOSPACE read-only mode (PR 10): a persist that hits
+        # EtcdNoSpace HOLDS its Ready — the Ready contract (persist
+        # before send) is preserved by simply not advancing: no
+        # messages leave, nothing applies, writes are rejected with
+        # errorCode 405, and the held Ready is re-persisted at probe
+        # cadence until the disk takes it.  The node just experiences
+        # a very slow disk.
+        self._nospace = False
+        self._held_ready = None
+        self._nospace_backoff = Backoff(base=0.25, cap=5.0,
+                                        site="nospace_probe")
+        self._nospace_probe_t = 0.0
+        self._m_nospace = _obs.registry.gauge("etcd_nospace_active")
         self.server_stats = ServerStats(
             attributes.get("Name", ""), id)
         self.leader_stats = leader_stats or LeaderStats(id)
@@ -249,30 +268,63 @@ class EtcdServer:
                 self.node.tick()
                 next_tick = now + self.tick_interval
             if is_leader and now >= next_sync:
-                self.sync(DEFAULT_SYNC_TIMEOUT)
+                # no SYNC proposals while read-only: the node's
+                # in-memory log must not outgrow a WAL that cannot
+                # take records (same guard as the dist/multigroup
+                # tiers)
+                if not self._nospace:
+                    self.sync(DEFAULT_SYNC_TIMEOUT)
                 next_sync = now + self.sync_interval
 
             wait_for = min(next_tick - now,
                            (next_sync - now) if is_leader else
                            self.tick_interval)
-            rd = self.node.ready(timeout=max(wait_for, 0.001))
-            if rd is None:
-                continue
+            if self._nospace and self._held_ready is None \
+                    and time.monotonic() >= self._nospace_probe_t:
+                # snapshot-triggered NOSPACE (no Ready to hold):
+                # probe the disk directly
+                try:
+                    probe = getattr(self.storage, "probe_space",
+                                    None)
+                    if probe is not None:
+                        probe()
+                    self._exit_nospace()
+                except EtcdNoSpace as e:
+                    self._enter_nospace(None, e)
+            if self._held_ready is not None:
+                # NOSPACE hold: don't pop further Readys (the node's
+                # unsent messages and unapplied commits queue behind
+                # this one); retry the held persist at probe cadence
+                if time.monotonic() < self._nospace_probe_t:
+                    self.done.wait(max(min(wait_for, 0.05), 0.001))
+                    continue
+                rd = self._held_ready
+            else:
+                rd = self.node.ready(timeout=max(wait_for, 0.001))
+                if rd is None:
+                    continue
 
             # persist BEFORE send (the Ready contract, node.go:41-60)
-            with tracer.stage("server.persist"):
-                self.storage.save(rd.hard_state, rd.entries)
-                self.storage.save_snap(rd.snapshot)
-                if not is_empty_snap(rd.snapshot):
-                    # the snapshot just became durable (file + dir
-                    # fsync inside save_snap): segments wholly
-                    # behind it are dead weight — GC here, never
-                    # before the fsync (delete-after-fsync rule).
-                    # getattr: the Storage seam is duck-typed and
-                    # test recorders predate gc()
-                    gc = getattr(self.storage, "gc", None)
-                    if gc is not None:
-                        gc(rd.snapshot.index)
+            try:
+                with tracer.stage("server.persist"):
+                    self.storage.save(rd.hard_state, rd.entries)
+                    self.storage.save_snap(rd.snapshot)
+                    if not is_empty_snap(rd.snapshot):
+                        # the snapshot just became durable (file +
+                        # dir fsync inside save_snap): segments
+                        # wholly behind it are dead weight — GC
+                        # here, never before the fsync
+                        # (delete-after-fsync rule).  getattr: the
+                        # Storage seam is duck-typed and test
+                        # recorders predate gc()
+                        gc = getattr(self.storage, "gc", None)
+                        if gc is not None:
+                            gc(rd.snapshot.index)
+            except EtcdNoSpace as e:
+                self._enter_nospace(rd, e)
+                continue
+            if self._held_ready is not None:
+                self._exit_nospace()
             for m in rd.messages:
                 if m.type == MSG_APP:
                     self.server_stats.send_append()
@@ -316,8 +368,38 @@ class EtcdServer:
                 appliedi = rd.snapshot.index
 
             if appliedi - snapi > self.snap_count:
-                self.snapshot(appliedi, nodes)
+                try:
+                    self.snapshot(appliedi, nodes)
+                except EtcdNoSpace as e:
+                    # no Ready to hold here — just go read-only and
+                    # probe; the snapshot trigger re-fires once
+                    # space returns
+                    self._enter_nospace(None, e)
                 snapi = appliedi
+
+    # -- NOSPACE read-only mode (PR 10) ------------------------------------
+
+    def _enter_nospace(self, rd, e: EtcdNoSpace) -> None:
+        if rd is not None:
+            self._held_ready = rd
+        if not self._nospace:
+            self._nospace = True
+            self._nospace_backoff.reset()
+            self._m_nospace.set(1)
+            log.error("etcdserver: ENTERING NOSPACE read-only mode "
+                      "(%s): writes rejected with errorCode 405, "
+                      "reads keep serving", e.cause)
+        self._nospace_probe_t = (time.monotonic()
+                                 + self._nospace_backoff.next())
+
+    def _exit_nospace(self) -> None:
+        self._held_ready = None
+        if self._nospace:
+            self._nospace = False
+            self._nospace_backoff.reset()
+            self._m_nospace.set(0)
+            log.warning("etcdserver: NOSPACE recovered — accepting "
+                        "writes again")
 
     # -- client request path -----------------------------------------------
 
@@ -329,6 +411,11 @@ class EtcdServer:
         if r.method == "GET" and r.quorum:
             r.method = "QGET"
         if r.method in ("POST", "PUT", "DELETE", "QGET"):
+            if self._nospace:
+                # read-only NOSPACE mode: the distinct error code,
+                # not a timeout (reads below still serve)
+                raise EtcdNoSpace(
+                    cause="member is read-only (NOSPACE)")
             data = r.marshal()
             ch = self.w.register(r.id)
             try:

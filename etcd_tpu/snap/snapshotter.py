@@ -9,12 +9,15 @@ good snapshot.
 
 from __future__ import annotations
 
+import errno
 import logging
 import os
 from typing import Callable
 
 from ..crc import value as crc_value
-from ..utils.fsio import fsync_dir
+from ..utils import faults as _faults
+from ..utils.errors import EtcdNoSpace
+from ..utils.fsio import fsync as fsio_fsync, fsync_dir
 from ..wire import SnapPb, Snapshot, is_empty_snap
 from ..wire.proto import ProtoError
 
@@ -78,14 +81,43 @@ class Snapshotter:
         b = snapshot.marshal()
         crc = self.crc_fn(b)
         d = SnapPb(crc=crc, data=b).marshal()
+        fpath = os.path.join(self.dir, fname)
         # contents + directory entry fsynced before returning: the
         # callers cut the WAL right after save_snap, so a snapshot
         # that evaporates in a crash would strand the log tail
-        # behind a segment boundary with no state to stand on
-        with open(os.path.join(self.dir, fname), "wb") as f:
-            f.write(d)
-            f.flush()
-            os.fsync(f.fileno())
+        # behind a segment boundary with no state to stand on.
+        # ENOSPC (real or the snap.save failpoint) removes the
+        # partial file — older durable snapshots remain, the caller
+        # enters NOSPACE mode — and any OTHER fsync failure is
+        # fail-stop (utils/fsio.fsync semantics, shared rule).
+        try:
+            _faults.hit("snap.save")
+            with open(fpath, "wb") as f:
+                f.write(d)
+                # fsio.fsync: ENOSPC -> EtcdNoSpace, anything else
+                # fail-stop (never returns on failure)
+                fsio_fsync(f)
+        except EtcdNoSpace:
+            # fsync-time full disk: drop the partial file so a
+            # truncated snapshot can never be loaded, then degrade
+            try:
+                os.remove(fpath)
+            except OSError:
+                pass
+            fsync_dir(self.dir)
+            raise
+        except OSError as e:
+            # open/write-time failure (fsync errors never get here)
+            if e.errno == errno.ENOSPC:
+                try:
+                    os.remove(fpath)
+                except OSError:
+                    pass
+                fsync_dir(self.dir)
+                raise EtcdNoSpace(
+                    cause=f"snapshot save {fname}: {e}") from e
+            _faults.fail_stop(
+                f"snapshot write failed on {fpath}: {e}", e)
         fsync_dir(self.dir)
         # the NEW snapshot is durable (file + dir entry) — only now
         # may older snapshots be deleted (delete-after-fsync; the

@@ -46,6 +46,7 @@ import numpy as np
 
 from ..crc import update as crc_update
 from ..obs import metrics as _obs
+from ..utils import faults as _faults
 
 log = logging.getLogger(__name__)
 
@@ -363,6 +364,23 @@ class ChunkPuller:
                         refetch(k)
                 continue
             _, k, status, body = ev
+            # receiver-side failpoint (PR 10): drop loses this
+            # response (paced refetch recovers, same as a transport
+            # hiccup); corrupt flips a byte INTO the CRC verifier —
+            # the reject+refetch path, without donor cooperation
+            try:
+                act = _faults.hit("snapstream.pull")
+            except OSError as e:
+                raise SnapStreamError(
+                    f"injected pull fault: {e}") from e
+            if act == _faults.DROP:
+                outstanding.discard(k)
+                fail_streak += 1
+                time.sleep(min(0.02 * fail_streak, 0.3))
+                refetch(k)
+                continue
+            if act == _faults.CORRUPT:
+                body = _faults.flip_byte(body)
             if status in (404, 410):
                 raise StaleSourceError(
                     f"donor no longer pins source {self.source_id}")

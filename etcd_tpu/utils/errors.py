@@ -44,6 +44,10 @@ ECODE_EVENT_INDEX_CLEARED = 401
 ECODE_STANDBY_INTERNAL = 402
 ECODE_INVALID_ACTIVE_SIZE = 403
 ECODE_INVALID_REMOVE_DELAY = 404
+# ENOSPC degradation (PR 10): the member's data disk is full; it
+# serves reads but rejects writes until GC frees space (the NOSPACE
+# alarm of the reference lineage, as a v2-style numeric code)
+ECODE_NO_SPACE = 405
 
 # client related errors
 ECODE_CLIENT_INTERNAL = 500
@@ -77,6 +81,7 @@ ERROR_MESSAGES = {
     ECODE_STANDBY_INTERNAL: "Standby Internal Error",
     ECODE_INVALID_ACTIVE_SIZE: "Invalid active size",
     ECODE_INVALID_REMOVE_DELAY: "Standby remove delay",
+    ECODE_NO_SPACE: "No space on data disk; member is read-only",
     ECODE_CLIENT_INTERNAL: "Client Internal Error",
 }
 
@@ -106,6 +111,8 @@ class EtcdError(Exception):
         """Reference error/error.go:139-151."""
         if self.error_code == ECODE_KEY_NOT_FOUND:
             return 404
+        if self.error_code == ECODE_NO_SPACE:
+            return 507  # Insufficient Storage
         if self.error_code in (ECODE_NOT_FILE, ECODE_DIR_NOT_EMPTY):
             return 403
         if self.error_code in (ECODE_TEST_FAILED, ECODE_NODE_EXIST):
@@ -113,3 +120,16 @@ class EtcdError(Exception):
         if self.error_code // 100 == 3:
             return 500
         return 400
+
+
+class EtcdNoSpace(EtcdError):
+    """Typed ENOSPC degradation signal (PR 10): a WAL/snapshot
+    writer could not allocate space.  Servers catching this enter a
+    read-only NOSPACE mode (serve lease/ReadIndex GETs, reject
+    writes with :data:`ECODE_NO_SPACE`) and recover by probing the
+    disk with backoff — never by crash-looping, and NEVER by
+    retrying a failed fsync (that path is fail-stop, see
+    utils/faults.fail_stop)."""
+
+    def __init__(self, cause: str = "", index: int = 0):
+        super().__init__(ECODE_NO_SPACE, cause, index)

@@ -6,11 +6,44 @@ the containing directory.  The reference leans on the same pattern
 (``fileutil`` in later etcd); here it is one helper so the
 durability-ordering checker (etcd_tpu/analysis/durability.py) can
 recognize the seam by name.
+
+Failure semantics (PR 10): both helpers are fault-injection seams
+(``fsio.fsync`` / ``fsio.fsync_dir`` in utils/faults.FAULT_CATALOG).
+:func:`fsync` treats ENOSPC as the graceful-degradation signal
+(typed ``EtcdNoSpace``) and EVERY other fsync failure as fail-stop —
+after one failed fsync the kernel may have dropped the dirty pages
+while a retry reports success, so retrying is silent data loss (the
+panic-on-fsync-error lesson of the reference lineage).
 """
 
 from __future__ import annotations
 
+import errno
 import os
+
+from . import faults as _faults
+
+
+def fsync(f) -> None:
+    """flush + fsync a writable file object (or fsync a raw fd)
+    through the fault seam.  ENOSPC raises ``EtcdNoSpace`` (callers
+    enter read-only NOSPACE mode); any other OSError is fail-stop —
+    this helper either returns with the bytes durable or the
+    process is down."""
+    try:
+        _faults.hit("fsio.fsync")
+        if isinstance(f, int):
+            os.fsync(f)
+        else:
+            f.flush()
+            os.fsync(f.fileno())
+    except OSError as e:
+        if e.errno == errno.ENOSPC:
+            from .errors import EtcdNoSpace
+
+            raise EtcdNoSpace(cause=f"fsync: {e}") from e
+        _faults.fail_stop(f"fsync failed, cannot trust the page "
+                          f"cache any further: {e}", e)
 
 
 def fsync_dir(dirpath: str) -> None:
@@ -19,8 +52,11 @@ def fsync_dir(dirpath: str) -> None:
     that reject directory fsync (some network filesystems): the
     OSError is swallowed — matching the reference's fileutil
     behavior — because the caller's own file fsync already happened
-    and there is nothing more a caller could do."""
+    and there is nothing more a caller could do.  Injected faults
+    (``fsio.fsync_dir``) follow the same swallow contract; the
+    activation is still billed."""
     try:
+        _faults.hit("fsio.fsync_dir")
         fd = os.open(dirpath, os.O_RDONLY)
     except OSError:
         return

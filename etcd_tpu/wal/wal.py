@@ -19,6 +19,7 @@ durable read/write seam shared by both paths.
 
 from __future__ import annotations
 
+import errno
 import logging
 import os
 import struct
@@ -27,7 +28,9 @@ from typing import BinaryIO
 
 from ..crc import Digest
 from ..obs import metrics as _obs
-from ..utils.fsio import fsync_dir
+from ..utils import faults as _faults
+from ..utils.errors import EtcdNoSpace
+from ..utils.fsio import fsync as fsio_fsync, fsync_dir
 from ..wire import Entry, HardState, Record
 from .errors import (
     CRCMismatchError,
@@ -239,6 +242,9 @@ class WAL:
         self.seq = 0
         self.enti = 0  # index of the last entry saved
         self.encoder: _Encoder | None = None
+        # path of the append-mode segment (fdopen'd handles carry no
+        # usable .name — the ENOSPC rollback reopens by path)
+        self._fpath = ""
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -256,6 +262,7 @@ class WAL:
         w.md = metadata
         w.seq = 0
         w.f = f
+        w._fpath = p
         w.encoder = _Encoder(f, 0)
         w._save_crc(0)
         w.encoder.encode(Record(type=METADATA_TYPE, data=metadata))
@@ -282,12 +289,14 @@ class WAL:
         if not names:
             raise FileNotFoundError_(dirpath)
         seq, _ = parse_wal_name(names[-1])
-        f = _open_append_0600(os.path.join(dirpath, names[-1]))
+        p = os.path.join(dirpath, names[-1])
+        f = _open_append_0600(p)
         w = cls()
         w.dir = dirpath
         w.md = metadata
         w.seq = seq
         w.f = f
+        w._fpath = p
         w.enti = enti
         w.encoder = _Encoder(f, last_crc)
         return w
@@ -300,13 +309,15 @@ class WAL:
         files = [open(os.path.join(dirpath, n), "rb")
                  for n in names]
         seq, _ = parse_wal_name(names[-1])
-        f = open(os.path.join(dirpath, names[-1]), "ab")
+        p = os.path.join(dirpath, names[-1])
+        f = open(p, "ab")
 
         w = cls()
         w.dir = dirpath
         w.ri = index
         w.decoder = _Decoder(files)
         w.f = f
+        w._fpath = p
         w.seq = seq
         return w
 
@@ -375,9 +386,10 @@ class WAL:
                         # before replay returns: a crash after a
                         # repaired-but-unsynced truncate would
                         # resurrect the torn bytes on the next open
+                        # (fsio.fsync seam: EIO here is fail-stop)
                         tfd = os.open(path, os.O_RDONLY)
                         try:
-                            os.fsync(tfd)
+                            fsio_fsync(tfd)
                         finally:
                             os.close(tfd)
                     doomed = self.decoder.files[fi + 1:]
@@ -403,6 +415,7 @@ class WAL:
                         # (now removed) file
                         self.f.close()
                         self.f = _open_append_0600(path)
+                        self._fpath = path
                         self.seq, _ = parse_wal_name(
                             os.path.basename(path))
                     fsync_dir(self.dir)
@@ -481,12 +494,19 @@ class WAL:
         (reference wal/wal.go:219-238)."""
         if self.encoder is None:
             raise WALError("wal not in append mode")
+        try:
+            _faults.hit("wal.cut")
+        except OSError as e:
+            if e.errno == errno.ENOSPC:
+                raise EtcdNoSpace(cause=f"wal cut: {e}") from e
+            raise
         fpath = os.path.join(self.dir, wal_name(self.seq + 1, self.enti + 1))
         f = _open_append_0600(fpath)
         self.sync()
         self.f.close()
 
         self.f = f
+        self._fpath = fpath
         self.seq += 1
         prev_crc = self.encoder.crc.sum32()
         self.encoder = _Encoder(self.f, prev_crc)
@@ -518,6 +538,7 @@ class WAL:
         (the same per-remove discipline as the torn-tail repair,
         mirrored — that one removes newest-first to keep a contiguous
         PREFIX)."""
+        _faults.hit("wal.gc")
         names = sorted(check_wal_names(os.listdir(self.dir)))
         i = search_index(names, index)
         if not i:  # None (index below the chain) or 0: nothing behind
@@ -535,11 +556,51 @@ class WAL:
         return i
 
     def sync(self) -> None:
-        if self.f is not None:
-            t0 = time.perf_counter()
+        """flush + fsync the append segment.  Failure semantics
+        (PR 10): ENOSPC raises the typed ``EtcdNoSpace`` (``save``
+        rolls the file back to the pre-batch mark and the server
+        enters read-only NOSPACE mode); ANY other fsync error is
+        FAIL-STOP — after one failed fsync the kernel may have
+        dropped the dirty pages while a retry reports success, so a
+        server that retried could ack writes that no longer exist
+        (the silent-loss class etcd grew panic-on-fsync-error for).
+        This method either returns with the bytes durable, raises
+        EtcdNoSpace with the file unchanged on disk semantics, or
+        the process is down."""
+        if self.f is None:
+            return
+        t0 = time.perf_counter()
+        try:
+            _faults.hit("wal.fsync")
             self.f.flush()
             os.fsync(self.f.fileno())
-            _FSYNC_HIST.observe(time.perf_counter() - t0)
+        except OSError as e:
+            if e.errno == errno.ENOSPC:
+                raise EtcdNoSpace(cause=f"wal fsync: {e}") from e
+            _faults.fail_stop(
+                f"wal fsync failed on {self._fpath}: {e} — a "
+                f"server that retries fsync may silently lose "
+                f"acked writes", e)
+        _FSYNC_HIST.observe(time.perf_counter() - t0)
+
+    def probe_space(self) -> None:
+        """NOSPACE recovery probe: exercise the append + fsync seams
+        without writing any record.  Raises ``EtcdNoSpace`` while
+        the disk (or an armed ``enospc`` failpoint window) still
+        refuses; returns cleanly once space is back so the server
+        can leave read-only mode."""
+        if self.f is None:
+            raise WALError("wal closed")
+        try:
+            _faults.hit("wal.append")
+            _faults.hit("wal.fsync")
+            self.f.flush()
+            os.fsync(self.f.fileno())
+        except OSError as e:
+            if e.errno == errno.ENOSPC:
+                raise EtcdNoSpace(cause=f"nospace probe: {e}") from e
+            _faults.fail_stop(
+                f"wal probe fsync failed on {self._fpath}: {e}", e)
 
     def close(self) -> None:
         if self.decoder is not None:
@@ -547,7 +608,13 @@ class WAL:
             self.decoder = None
         if self.f is not None:
             if self.encoder is not None:
-                self.sync()
+                try:
+                    self.sync()
+                except EtcdNoSpace:
+                    # best-effort final sync on a full disk: every
+                    # acked write was already fsynced by its save();
+                    # anything buffered here was never acked
+                    log.warning("wal: close() sync skipped (ENOSPC)")
             self.f.close()
             self.f = None
 
@@ -571,13 +638,71 @@ class WAL:
         """HardState + entries + fsync — the Ready-contract durability
         step (reference wal/wal.go:281-288, state record first for
         byte-layout parity; read_all's repair clamp covers the
-        state-before-entries tear case)."""
-        self.save_state(st)
-        for e in ents:
-            self.save_entry(e)
+        state-before-entries tear case).
+
+        ENOSPC anywhere in the batch (write, flush, or fsync) rolls
+        the segment back to the pre-batch mark — truncate below any
+        bytes whose writeback the kernel may have dropped, fsync the
+        truncation — and raises the typed ``EtcdNoSpace``: the WAL
+        stays append-usable, nothing in the failed batch was ever
+        acked, and everything before the mark was already durable
+        from the previous save.  Any OTHER I/O error is fail-stop
+        (see :meth:`sync`)."""
+        if self.encoder is None:
+            raise WALError("wal not in append mode (read_all first)")
+        mark = (self.f.tell(), self.encoder.crc.sum32(), self.enti)
+        try:
+            _faults.hit("wal.append")
+            self.save_state(st)
+            for e in ents:
+                self.save_entry(e)
+        except OSError as e:
+            if e.errno == errno.ENOSPC:
+                self._rollback(mark, e)  # raises EtcdNoSpace
+            _faults.fail_stop(
+                f"wal append failed on {self._fpath}: {e}", e)
         if ents:
             _APPEND_CTR.inc(len(ents))
-        self.sync()
+        try:
+            self.sync()
+        except EtcdNoSpace as e:
+            self._rollback(mark, e)
+            raise  # unreachable — _rollback always raises; keeps
+            #        the no-return-without-fsync path explicit
+
+    def _rollback(self, mark: tuple[int, int, int], cause) -> None:
+        """Revert the append segment to the pre-batch ``mark`` after
+        an ENOSPC: reopen (dropping any unflushable buffer),
+        truncate to the mark (discarding bytes whose writeback may
+        already have been dropped — they were never acked), fsync
+        the truncation, and rebuild the encoder on the pre-batch
+        rolling CRC.  Raises ``EtcdNoSpace``; if even the rollback
+        fails the only honest state is fail-stop."""
+        off, crc, enti = mark
+        try:
+            try:
+                self.f.close()  # flush may re-raise ENOSPC: ignore
+            except OSError:
+                pass
+            os.truncate(self._fpath, off)
+            tfd = os.open(self._fpath, os.O_RDONLY)
+            try:
+                os.fsync(tfd)
+            finally:
+                os.close(tfd)
+            self.f = _open_append_0600(self._fpath)
+            self.encoder = _Encoder(self.f, crc)
+            self.enti = enti
+        except OSError as e:
+            _faults.fail_stop(
+                f"wal ENOSPC rollback failed on {self._fpath}: {e} "
+                f"(original: {cause})", e)
+        log.warning("wal: ENOSPC — rolled %s back to byte %d (%s)",
+                    os.path.basename(self._fpath), off, cause)
+        if isinstance(cause, EtcdNoSpace):
+            raise cause
+        raise EtcdNoSpace(cause=f"wal save: {cause}") from (
+            cause if isinstance(cause, BaseException) else None)
 
     def _save_crc(self, prev_crc: int) -> None:
         self.encoder.encode(Record(type=CRC_TYPE, crc=prev_crc))

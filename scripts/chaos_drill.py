@@ -56,7 +56,15 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BASE = "/tmp/chaosd"
 PEERS = [f"http://127.0.0.1:1785{i}" for i in range(3)]
 CLIENT = [f"http://127.0.0.1:1486{i}" for i in range(3)]
-_pos = [a for a in sys.argv[1:] if a.isdigit()]
+_argv = sys.argv[1:]
+# --seed N (nemesis replay): extracted BEFORE the bare-digit scan so
+# the seed value cannot be mistaken for the CYCLES positional
+NEMESIS_SEED = None
+if "--seed" in _argv:
+    _si = _argv.index("--seed")
+    NEMESIS_SEED = int(_argv[_si + 1])
+    _argv = _argv[:_si] + _argv[_si + 2:]
+_pos = [a for a in _argv if a.isdigit()]
 CYCLES = int(_pos[0]) if _pos else 6
 deep_lag = "--deep-lag" in sys.argv
 tear = "--tear" in sys.argv
@@ -617,7 +625,436 @@ def linz_drill(cycles: int) -> None:
                 pass
 
 
+# -- nemesis chaos schedules (PR 10) ----------------------------------------
+#
+# ``--nemesis [CYCLES] [--seed N] [--smoke] [--check]`` composes
+# randomized gray-failure schedules from a printed seed: leader kill,
+# one-way partition (all inbound dropped at one node), follower
+# fsync-EIO (must fail-stop), NOSPACE episodes (enter / serve-reads /
+# recover) and probabilistic link delay — armed and cleared at
+# runtime via POST /mraft/faults, so one server process lives through
+# many distinct fault windows.  Re-running the printed seed
+# reproduces the exact schedule (op kinds, victims, durations, specs)
+# and therefore the same deterministic (once-qualified) injections.
+
+NEMESIS_KINDS = ("one_way_partition", "link_delay", "fsync_eio",
+                 "nospace", "leader_kill")
+
+
+def plan_nemesis(seed: int, cycles: int, smoke: bool) -> list[list]:
+    """Deterministic schedule: cycle c runs kinds[2c..2c+1] (mod 5),
+    so >= 3 cycles cover every kind; all parameters (victims,
+    directions, durations, delay probabilities) come from the seeded
+    RNG.  Returns a list of cycles, each a list of op dicts."""
+    rng = random.Random(seed)
+    if smoke:
+        # one short cycle: delay window + NOSPACE episode + EIO
+        # fail-stop (the partition/kill arms live in --check runs)
+        src = rng.randrange(3)
+        return [[
+            {"kind": "link_delay", "src": src,
+             "dst": (src + 1 + rng.randrange(2)) % 3,
+             "dur": 6.0, "ms": 20 + rng.randrange(20),
+             "p": 0.5},
+            {"kind": "nospace", "dur": 3.0},
+            {"kind": "fsync_eio"},
+        ]]
+    plan = []
+    for c in range(cycles):
+        ops = []
+        for k in (NEMESIS_KINDS[(2 * c) % 5],
+                  NEMESIS_KINDS[(2 * c + 1) % 5]):
+            op = {"kind": k}
+            if k == "one_way_partition":
+                op["victim"] = rng.randrange(3)
+                op["dur"] = 8.0 + rng.randrange(5)
+            elif k == "link_delay":
+                op["src"] = rng.randrange(3)
+                op["dst"] = (op["src"] + 1 + rng.randrange(2)) % 3
+                op["dur"] = 6.0 + rng.randrange(4)
+                op["ms"] = 20 + rng.randrange(40)
+                op["p"] = round(0.3 + 0.4 * rng.random(), 2)
+            elif k == "nospace":
+                op["dur"] = 3.0 + rng.randrange(3)
+            ops.append(op)
+        plan.append(ops)
+    return plan
+
+
+def set_faults(slot, spec, seed=None, timeout=5):
+    body = json.dumps({"spec": spec, "seed": seed}).encode()
+    req = urllib.request.Request(
+        PEERS[slot] + "/mraft/faults", data=body, method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        out = json.loads(r.read())
+    assert out.get("ok"), out
+    return out
+
+
+def get_faults(slot, timeout=5):
+    with urllib.request.urlopen(PEERS[slot] + "/mraft/faults",
+                                timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def obs_gauge(snap, family):
+    for s in snap.get(family, {}).get("samples", []):
+        return s.get("value", 0.0)
+    return 0.0
+
+
+def nemesis_drill(cycles: int, smoke: bool, check: bool) -> None:
+    global procs
+    from etcd_tpu.utils.faults import FAIL_STOP_EXIT
+
+    seed = NEMESIS_SEED if NEMESIS_SEED is not None \
+        else random.SystemRandom().randrange(1, 1 << 31)
+    plan = plan_nemesis(seed, cycles, smoke)
+    print(f"NEMESIS SEED={seed}  (replay: python scripts/"
+          f"chaos_drill.py --nemesis {cycles} --seed {seed}"
+          f"{' --smoke' if smoke else ''}"
+          f"{' --check' if check else ''})", flush=True)
+    print("NEMESIS PLAN: " + json.dumps(plan), flush=True)
+    # replay determinism: the schedule is a pure function of the seed
+    assert plan == plan_nemesis(seed, cycles, smoke)
+
+    flight_dir = os.path.join(BASE, "flight")
+    env["ETCD_FLIGHT_DIR"] = flight_dir
+    shutil.rmtree(BASE, ignore_errors=True)
+    os.makedirs(flight_dir, exist_ok=True)
+    procs = {i: start(i) for i in range(3)}
+    rng = random.Random(seed ^ 0x5EED)  # client-side choices only
+    N_CLIENTS = 3
+    stale: list[tuple] = []
+    stats = {"acked": 0, "reads_ok": 0, "reads_rejected": 0,
+             "write_fail": 0}
+    stats_lock = threading.Lock()
+    stop = threading.Event()
+    alive = [True, True, True]
+    issued: dict[str, set] = {}
+    eio_results = []      # (victim, returncode, dump_ok)
+    nospace_results = []  # (rejected_405, read_ok, recovered)
+
+    def client_loop(t):
+        # writer-reader pair per key: a linearizable default GET may
+        # fail closed but must NEVER observe a value older than this
+        # client's own preceding acked write
+        key = f"{KEYS[t % len(KEYS)]}nm{t}"
+        acked_v = -1
+        acked_set = set()
+        v = 0
+        while not stop.is_set():
+            v += 1
+            targets = [i for i in range(3) if alive[i]]
+            if not targets:
+                time.sleep(0.3)
+                continue
+            val = f"v{v}"
+            issued.setdefault(key, set()).add(val)
+            try:
+                put(CLIENT[rng.choice(targets)], key, val, timeout=3)
+                acked_v = v
+                acked_set.add(v)
+                with stats_lock:
+                    stats["acked"] += 1
+            except Exception:
+                with stats_lock:
+                    stats["write_fail"] += 1
+            try:
+                got = get(CLIENT[rng.choice(targets)], key,
+                          timeout=3)["node"]["value"]
+            except Exception:
+                with stats_lock:
+                    stats["reads_rejected"] += 1
+                continue
+            gv = int(got[1:])
+            if gv < acked_v and gv in acked_set:
+                stale.append((t, key, acked_v, gv, time.time()))
+            with stats_lock:
+                stats["reads_ok"] += 1
+            time.sleep(0.02)
+
+    def wait_writable(deadline_s, who="cluster"):
+        deadline = time.time() + deadline_s
+        for key in KEYS:
+            while True:
+                tgt = rng.choice([i for i in range(3) if alive[i]])
+                try:
+                    put(CLIENT[tgt], key, "probe", timeout=3)
+                    issued.setdefault(key, set()).add("probe")
+                    break
+                except Exception:
+                    if time.time() > deadline:
+                        raise RuntimeError(
+                            f"{who} not writable within "
+                            f"{deadline_s}s")
+                    time.sleep(0.5)
+
+    def leader_slot_alive():
+        counts = {s: 0 for s in range(3) if alive[s]}
+        for s, d in fetch_leaders(list(counts)).items():
+            counts[s] = sum(1 for x in d["lead"] if x)
+        return max(counts, key=counts.get)
+
+    def op_one_way_partition(op):
+        v = op["victim"]
+        print(f"  nemesis: one-way partition — s{v} inbound "
+              f"dropped for {op['dur']:.0f}s", flush=True)
+        set_faults(v, f"peerlink.recv[*->s{v}]=drop()", seed)
+        time.sleep(op["dur"])
+        set_faults(v, "")
+        # heal gate: the cluster must settle writable again (a
+        # deposed-by-step-down leader re-earns lanes or the others
+        # keep them)
+        wait_writable(45, who="post-partition cluster")
+
+    def op_link_delay(op):
+        s = op["src"]
+        d = op["dst"]
+        spec = (f"peerlink.send[s{s}->s{d}]="
+                f"delay({op['ms']}ms,p={op['p']})")
+        print(f"  nemesis: link delay — {spec} for "
+              f"{op['dur']:.0f}s", flush=True)
+        set_faults(s, spec, seed)
+        time.sleep(op["dur"])
+        set_faults(s, "")
+        wait_writable(30, who="post-delay cluster")
+
+    def op_fsync_eio(op):
+        # a follower of MOST lanes (any non-leader slot): the next
+        # replicated write's fsync must fail-stop the process
+        lead = leader_slot_alive()
+        v = next(i for i in range(3) if i != lead and alive[i])
+        print(f"  nemesis: fsync-EIO on follower s{v} "
+              f"(leader s{lead})", flush=True)
+        t_arm = time.time()
+        set_faults(v, "wal.fsync=err(EIO,once)", seed)
+        alive[v] = False  # clients steer away; the node is doomed
+        try:
+            procs[v].wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            raise AssertionError(
+                f"s{v} did not fail-stop within 30s of the armed "
+                f"fsync-EIO (writes were flowing)")
+        rc = procs[v].returncode
+        # the fail-stop dump must exist and carry the fault event
+        dump_ok = False
+        for fn in os.listdir(flight_dir):
+            if "failstop" not in fn:
+                continue
+            if os.path.getmtime(os.path.join(flight_dir, fn)) \
+                    < t_arm - 1:
+                continue
+            with open(os.path.join(flight_dir, fn)) as f:
+                d = json.load(f)
+            evs = [e for e in d.get("events", [])
+                   if e.get("c") == "fault"
+                   and e.get("point") == "wal.fsync"]
+            if len(evs) == 1:
+                dump_ok = True
+        eio_results.append((v, rc, dump_ok))
+        print(f"  nemesis: s{v} exited rc={rc} "
+              f"(FAIL_STOP_EXIT={FAIL_STOP_EXIT}), "
+              f"failstop dump={'ok' if dump_ok else 'MISSING'}",
+              flush=True)
+        procs[v] = start(v)
+        time.sleep(12)
+        alive[v] = True
+        wait_writable(45, who="post-EIO cluster")
+
+    def op_nospace(op):
+        # the busiest leader: reads must keep serving under its
+        # lease while writes bounce with the distinct 405 code, and
+        # the episode must END with writes accepted again
+        v = leader_slot_alive()
+        dur = op["dur"]
+        print(f"  nemesis: NOSPACE on leader s{v} for {dur:.0f}s",
+              flush=True)
+        set_faults(v, f"wal.append=enospc(for={dur}s)", seed)
+        rejected = read_ok = recovered = False
+        deadline = time.time() + dur + 2
+        key = KEYS[0]
+        while time.time() < deadline and not (rejected and read_ok):
+            try:
+                put(CLIENT[v], key, "nospace-probe", timeout=3)
+                issued.setdefault(key, set()).add("nospace-probe")
+            except urllib.error.HTTPError as e:
+                body = json.loads(e.read() or b"{}")
+                if body.get("errorCode") == 405:
+                    rejected = True
+            except Exception:
+                pass
+            try:
+                get(CLIENT[v], key, timeout=3)
+                read_ok = True
+            except urllib.error.HTTPError:
+                read_ok = True  # 404 = served
+            except Exception:
+                pass
+            time.sleep(0.3)
+        # recovery: the window lapses, the probe clears the flag,
+        # and a write through the SAME node succeeds
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                if obs_gauge(fetch_obs(v), "etcd_nospace_active"):
+                    time.sleep(0.5)
+                    continue
+                put(CLIENT[v], key, "nospace-recovered", timeout=3)
+                issued.setdefault(key, set()).add(
+                    "nospace-recovered")
+                recovered = True
+                break
+            except Exception:
+                time.sleep(0.5)
+        set_faults(v, "")
+        nospace_results.append((rejected, read_ok, recovered))
+        print(f"  nemesis: NOSPACE episode on s{v}: "
+              f"rejected-405={rejected} reads-served={read_ok} "
+              f"recovered={recovered}", flush=True)
+
+    def op_leader_kill(op):
+        v = leader_slot_alive()
+        print(f"  nemesis: kill -9 leader s{v}", flush=True)
+        alive[v] = False
+        procs[v].send_signal(signal.SIGKILL)
+        procs[v].wait()
+        time.sleep(6)
+        procs[v] = start(v)
+        time.sleep(12)
+        alive[v] = True
+        wait_writable(45, who="post-kill cluster")
+
+    OPS = {"one_way_partition": op_one_way_partition,
+           "link_delay": op_link_delay,
+           "fsync_eio": op_fsync_eio,
+           "nospace": op_nospace,
+           "leader_kill": op_leader_kill}
+
+    try:
+        time.sleep(22)
+        deadline = time.time() + 60
+        for key in KEYS:
+            while True:
+                try:
+                    put(CLIENT[0], key, "warmup", timeout=3)
+                    issued.setdefault(key, set()).add("warmup")
+                    break
+                except Exception:
+                    if time.time() > deadline:
+                        raise RuntimeError("cluster failed to settle")
+                    time.sleep(0.5)
+        print("nemesis: settled", flush=True)
+        forced_gate_fail()
+        threads = [threading.Thread(target=client_loop, args=(t,),
+                                    daemon=True)
+                   for t in range(N_CLIENTS)]
+        for th in threads:
+            th.start()
+        for c, ops in enumerate(plan):
+            print(f"nemesis cycle {c}: "
+                  f"{[op['kind'] for op in ops]}", flush=True)
+            for op in ops:
+                OPS[op["kind"]](op)
+                assert not stale, stale
+        stop.set()
+        for th in threads:
+            th.join(5)
+        assert not stale, stale
+
+        # zero lost acked writes: every key's value on every replica
+        # is SOME issued write (a fabricated or lost value is the
+        # safety violation; a late-committing timed-out write is
+        # legal at-least-once)
+        lost = []
+        for s in range(3):
+            for k, vals in issued.items():
+                try:
+                    got = get(CLIENT[s], k, timeout=5,
+                              serializable=True)["node"]["value"]
+                except urllib.error.HTTPError:
+                    continue  # never committed on this replica
+                except Exception:
+                    continue
+                if got not in vals:
+                    lost.append((s, k, got))
+        assert not lost, lost
+
+        # deterministic-injection evidence: the live nodes' counters
+        injected = {}
+        for s in range(3):
+            try:
+                injected[s] = get_faults(s).get("injected", {})
+            except Exception:
+                pass
+        print(f"nemesis: injected (live nodes)={injected}",
+              flush=True)
+        with stats_lock:
+            print(f"nemesis: {stats}", flush=True)
+        if check:
+            n_eio = sum(1 for ops in plan for op in ops
+                        if op["kind"] == "fsync_eio")
+            n_nospace = sum(1 for ops in plan for op in ops
+                            if op["kind"] == "nospace")
+            assert len(eio_results) == n_eio
+            for v, rc, dump_ok in eio_results:
+                assert rc == FAIL_STOP_EXIT, \
+                    (f"s{v} exited rc={rc}, expected the fail-stop "
+                     f"code {FAIL_STOP_EXIT}")
+                assert dump_ok, \
+                    f"s{v} left no failstop flight dump with the " \
+                    f"wal.fsync fault event"
+            assert len(nospace_results) == n_nospace
+            for rejected, read_ok, recovered in nospace_results:
+                assert rejected, "no write saw the 405 NOSPACE code"
+                assert read_ok, "reads did not serve during NOSPACE"
+                assert recovered, "NOSPACE episode did not recover"
+            assert stats["acked"] > 0 and stats["reads_ok"] > 0
+            # replay determinism, stated precisely: the plan is a
+            # pure function of the seed (re-derived + compared at
+            # startup) and every once-qualified injection fired
+            # EXACTLY once (the per-victim dump check above); the
+            # for=/p= rows depend on traffic timing and reproduce
+            # in distribution only.
+            print(f"nemesis: deterministic injections — "
+                  f"{n_eio} once-qualified EIO planned, "
+                  f"{sum(1 for _v, _rc, ok in eio_results if ok)} "
+                  f"observed exactly-once in flight dumps",
+                  flush=True)
+        print(f"NEMESIS DRILL CLEAN: seed={seed}, "
+              f"{sum(len(ops) for ops in plan)} ops over "
+              f"{len(plan)} cycle(s), {stats['acked']} acked "
+              f"writes, {stats['reads_ok']} reads served "
+              f"({stats['reads_rejected']} fail-closed), ZERO "
+              f"stale reads, ZERO lost acked writes, "
+              f"{len(eio_results)} fail-stop exit(s), "
+              f"{len(nospace_results)} NOSPACE episode(s) "
+              f"recovered", flush=True)
+    except (AssertionError, RuntimeError):
+        stop.set()
+        print(f"NEMESIS GATE FAILURE — replay with: python "
+              f"scripts/chaos_drill.py --nemesis {cycles} "
+              f"--seed {seed}", flush=True)
+        harvest_flight("nemesis")
+        raise
+    finally:
+        stop.set()
+        for p in procs.values():
+            try:
+                p.kill()
+            except Exception:
+                pass
+
+
+nemesis_mode = "--nemesis" in sys.argv
 linz_mode = "--linz" in sys.argv
+
+if nemesis_mode:
+    nemesis_drill(int(_pos[0]) if _pos else 3,
+                  smoke="--smoke" in sys.argv,
+                  check="--check" in sys.argv)
+    sys.exit(0)
 
 if deep_lag:
     deep_lag_drill(int(_pos[0]) if _pos else 2500)

@@ -1105,6 +1105,64 @@ def test_errorvocab_quiet_on_vocabulary_and_allowlist(tmp_path):
     assert run_checkers(root, [ErrorVocabularyChecker()]) == []
 
 
+# -- 5b. fault-vocabulary (PR 10) ---------------------------------------------
+
+
+def test_faultvocab_fires_on_seeded_violations(tmp_path):
+    from etcd_tpu.analysis import FaultVocabularyChecker
+
+    root = _fixture_root(tmp_path, "etcd_tpu/wal/bad.py", """
+        from ..utils import faults as _faults
+
+        def a():
+            _faults.hit("wal.fsnyc")        # typo'd point
+
+        def b(point):
+            _faults.hit(point)              # dynamic name
+
+        def c():
+            _faults.FAULTS.hit("not.in.catalog")
+    """)
+    findings = run_checkers(root, [FaultVocabularyChecker()])
+    rules = _rules(findings)
+    assert rules == {"unregistered-fault", "dynamic-fault-name"}
+    details = {f.detail for f in findings}
+    assert {"wal.fsnyc", "not.in.catalog", "_faults.hit"} <= details
+
+
+def test_faultvocab_quiet_on_catalog_points(tmp_path):
+    from etcd_tpu.analysis import FaultVocabularyChecker
+
+    root = _fixture_root(tmp_path, "etcd_tpu/wal/good.py", """
+        from ..utils import faults as _faults
+
+        def a():
+            _faults.hit("wal.fsync")
+
+        def b():
+            _faults.FAULTS.hit("peerlink.send", src="s0", dst="s1")
+
+        def c(obj):
+            obj.hit("whatever")             # not a faults receiver
+
+        def d(d):
+            d.hit()                         # no args, not faults-ish
+    """)
+    assert run_checkers(root, [FaultVocabularyChecker()]) == []
+
+
+def test_faultvocab_skips_the_catalog_module(tmp_path):
+    from etcd_tpu.analysis import FaultVocabularyChecker
+
+    root = _fixture_root(tmp_path, "etcd_tpu/utils/faults.py", """
+        FAULTS = None
+
+        def hit(point):
+            return FAULTS.hit(point)        # dynamic, but in-module
+    """)
+    assert run_checkers(root, [FaultVocabularyChecker()]) == []
+
+
 # -- 6. engine plumbing -------------------------------------------------------
 
 

@@ -795,14 +795,16 @@ def test_pull_failure_rearms_need_pull(tmp_path):
         srv._pull_snapshot()   # peers were never started: all dead
         assert srv._need_pull          # re-armed, not dropped
         assert srv._pull_not_before > t0
-        assert srv._pull_backoff > 0
+        # the shared Backoff (PR 10) is mid-escalation
+        assert srv._pull_backoff.pending
         assert obs.counter("etcd_snap_install_total",
                            outcome="no_donor").get() == before + 1
-        # second failure backs off further (exponential)
-        b1 = srv._pull_backoff
+        # second failure backs off further (exponential: the
+        # internal level doubles, jitter only shapes the delay)
+        b1 = srv._pull_backoff._cur
         srv._need_pull = False
         srv._pull_snapshot()
-        assert srv._pull_backoff == 2 * b1
+        assert srv._pull_backoff._cur == 2 * b1
     finally:
         srv.stop()
 

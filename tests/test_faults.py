@@ -1,0 +1,928 @@
+"""Deterministic fault injection + gray-failure semantics (PR 10).
+
+Covers: the spec grammar and activation gates (utils/faults), the
+shared backoff (utils/backoff), WAL failure semantics (ENOSPC
+rollback + fail-stop on fsync EIO), fail-stop subprocess exits on
+all three server tiers (no post-EIO ack ever reaches a client), the
+dist tier's NOSPACE enter/serve-reads/recover cycle, one-way
+partition check-quorum step-down, the delayed-acks stale-read guard,
+and the peerlink reconnect backoff regression.
+"""
+
+import errno
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from etcd_tpu.obs.metrics import CATALOG, MetricDef, Registry
+from etcd_tpu.utils import faults as faults_mod
+from etcd_tpu.utils.backoff import Backoff
+from etcd_tpu.utils.errors import ECODE_NO_SPACE, EtcdError, \
+    EtcdNoSpace
+from etcd_tpu.utils.faults import (
+    FAIL_STOP_EXIT,
+    FAULT_CATALOG,
+    FaultRegistry,
+    FaultSpecError,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Every test starts and ends with no armed faults and the
+    default fail-stop behavior (the module registry is process-wide
+    and in-process servers share it)."""
+    faults_mod.FAULTS.configure("")
+    faults_mod.FAULTS.reset_counts()
+    prev = faults_mod.set_fail_stop(None)
+    faults_mod.set_fail_stop(prev)
+    yield
+    faults_mod.FAULTS.configure("")
+    faults_mod.set_fail_stop(None)
+
+
+def fresh_registry(spec="", seed=1):
+    r = FaultRegistry(registry=Registry(CATALOG))
+    if spec:
+        r.configure(spec, seed=seed)
+    return r
+
+
+# -- spec grammar ------------------------------------------------------------
+
+
+def test_spec_actions_and_qualifiers_parse():
+    r = fresh_registry(
+        "wal.fsync=err(EIO,once);"
+        "wal.append=enospc(for=2s,after=1);"
+        "peerlink.send[s2->s1]=delay(50ms,p=0.3);"
+        "peerlink.recv[*->s0]=drop(times=3);"
+        "snapstream.serve=corrupt(once)")
+    assert len(r._rules) == 5
+    assert r._rules[0].err_no == errno.EIO
+    assert r._rules[0].times == 1
+    assert r._rules[1].err_no == errno.ENOSPC
+    assert r._rules[1].for_s == 2.0 and r._rules[1].after == 1
+    assert r._rules[2].delay_s == pytest.approx(0.05)
+    assert r._rules[2].src == "s2" and r._rules[2].dst == "s1"
+    assert r._rules[3].src == "*" and r._rules[3].dst == "s0"
+    assert r._rules[3].times == 3
+
+
+@pytest.mark.parametrize("bad", [
+    "wal.fsnyc=err(EIO)",              # typo'd point
+    "wal.fsync=explode()",             # unknown action
+    "wal.fsync=err()",                 # err needs an errno
+    "wal.fsync=err(ENOTANERRNO)",      # unknown errno
+    "wal.fsync=delay(banana)",         # bad duration
+    "wal.fsync=err(EIO,p=1.5)",        # p out of range
+    "wal.fsync",                       # missing '='
+    "peerlink.send[s1]=drop()",        # qualifier missing ->
+    "wal.append=enospc(EIO)",          # enospc takes no value
+])
+def test_bad_specs_fail_loudly(bad):
+    with pytest.raises(FaultSpecError):
+        fresh_registry(bad)
+
+
+def test_empty_spec_clears():
+    r = fresh_registry("wal.fsync=err(EIO)")
+    r.configure("")
+    assert r.hit("wal.fsync") is None
+
+
+# -- activation gates --------------------------------------------------------
+
+
+def test_once_fires_exactly_once():
+    r = fresh_registry("wal.fsync=err(EIO,once)")
+    with pytest.raises(OSError) as ei:
+        r.hit("wal.fsync")
+    assert ei.value.errno == errno.EIO
+    for _ in range(5):
+        assert r.hit("wal.fsync") is None
+    assert r.injected() == {"wal.fsync=err": 1}
+
+
+def test_after_skips_then_fires():
+    r = fresh_registry("peerlink.send=drop(after=2,times=1)")
+    assert r.hit("peerlink.send") is None
+    assert r.hit("peerlink.send") is None
+    assert r.hit("peerlink.send") == faults_mod.DROP
+    assert r.hit("peerlink.send") is None
+
+
+def test_for_window_expires():
+    r = fresh_registry("wal.append=enospc(for=0.15s)")
+    with pytest.raises(OSError):
+        r.hit("wal.append")
+    with pytest.raises(OSError):
+        r.hit("wal.append")
+    time.sleep(0.2)
+    assert r.hit("wal.append") is None  # window lapsed
+    assert r.hit("wal.append") is None
+
+
+def test_p_draws_deterministic_per_seed():
+    seq_a = []
+    seq_b = []
+    for out in (seq_a, seq_b):
+        r = fresh_registry("peerlink.send=drop(p=0.5)", seed=42)
+        for _ in range(64):
+            out.append(r.hit("peerlink.send") is not None)
+    assert seq_a == seq_b
+    assert any(seq_a) and not all(seq_a)
+    r2 = fresh_registry("peerlink.send=drop(p=0.5)", seed=43)
+    seq_c = [r2.hit("peerlink.send") is not None for _ in range(64)]
+    assert seq_c != seq_a  # a different seed draws differently
+
+
+def test_src_dst_matching_and_wildcards():
+    r = fresh_registry("peerlink.recv[*->s0]=drop()")
+    assert r.hit("peerlink.recv", src="s1", dst="s0") \
+        == faults_mod.DROP
+    assert r.hit("peerlink.recv", src="s2", dst="s0") \
+        == faults_mod.DROP
+    assert r.hit("peerlink.recv", src="s1", dst="s2") is None
+    assert r.hit("peerlink.send", src="s1", dst="s0") is None
+    r2 = fresh_registry("peerlink.send[s2->s1]=drop()")
+    assert r2.hit("peerlink.send", src="s2", dst="s1") \
+        == faults_mod.DROP
+    assert r2.hit("peerlink.send", src="s1", dst="s2") is None
+
+
+def test_delay_sleeps_then_proceeds():
+    r = fresh_registry("http.client=delay(30ms,times=1)")
+    t0 = time.monotonic()
+    assert r.hit("http.client") is None  # delayed but proceeding
+    assert time.monotonic() - t0 >= 0.025
+    t0 = time.monotonic()
+    assert r.hit("http.client") is None
+    assert time.monotonic() - t0 < 0.02
+
+
+def test_activation_billed_to_counter_and_sink():
+    reg = Registry(CATALOG)
+    r = FaultRegistry(registry=reg)
+    r.configure("snapstream.serve=corrupt(once)", seed=1)
+    events = []
+
+    class Sink:
+        def record(self, cls, **kw):
+            events.append((cls, kw))
+
+    s = Sink()
+    r.attach_sink(s)
+    assert r.hit("snapstream.serve", src="s1") == faults_mod.CORRUPT
+    assert reg.counter("etcd_fault_injected_total",
+                       point="snapstream.serve",
+                       action="corrupt").get() == 1
+    assert events == [("fault", {"point": "snapstream.serve",
+                                 "action": "corrupt", "src": "s1",
+                                 "dst": None})]
+    r.detach_sink(s)
+    r.configure("snapstream.serve=corrupt(once)", seed=1)
+    r.hit("snapstream.serve")
+    assert len(events) == 1  # detached
+
+
+def test_flip_byte():
+    assert faults_mod.flip_byte(b"abc") == b"ab" + bytes([ord("c")
+                                                          ^ 0xFF])
+    assert faults_mod.flip_byte(b"") == b""
+
+
+def test_fail_stop_hook_never_returns():
+    got = []
+    prev = faults_mod.set_fail_stop(
+        lambda reason, exc: got.append(reason))
+    try:
+        with pytest.raises(faults_mod.FailStopError):
+            faults_mod.fail_stop("boom", None)
+    finally:
+        faults_mod.set_fail_stop(prev)
+    assert got == ["boom"]
+
+
+def test_env_seed_and_catalog_docs():
+    # every catalog entry documents itself; the vocabulary is closed
+    assert all(isinstance(v, str) and v for v in
+               FAULT_CATALOG.values())
+    with pytest.raises(FaultSpecError):
+        fresh_registry("not.a.point=drop()")
+
+
+# -- shared backoff ----------------------------------------------------------
+
+
+def test_backoff_shape_is_the_snap_stream_shape():
+    import random as _random
+
+    b = Backoff(base=0.25, cap=30.0,
+                rng=_random.Random(7))
+    raw = []
+    cur = 0.25
+    for _ in range(10):
+        d = b.next()
+        assert 0.5 * cur <= d <= 1.5 * cur
+        raw.append(d)
+        cur = min(30.0, cur * 2)
+    assert b.pending
+    b.reset()
+    assert not b.pending
+    d = b.next()
+    assert 0.125 <= d <= 0.375  # back to base
+
+
+def test_backoff_first_zero():
+    b = Backoff(base=0.05, cap=5.0, first_zero=True)
+    assert b.next() == 0.0
+    assert b.pending
+    assert b.next() > 0.0
+    b.reset()
+    assert b.next() == 0.0
+
+
+def test_backoff_counter_billed_per_site():
+    before = __import__("etcd_tpu.obs.metrics",
+                        fromlist=["registry"]).registry.counter(
+        "etcd_backoff_retries_total", site="_test").get()
+    b = Backoff(base=0.01, cap=0.1, site="_test", first_zero=True)
+    b.next()  # the free zero-wait is NOT a retry
+    b.next()
+    b.next()
+    after = __import__("etcd_tpu.obs.metrics",
+                       fromlist=["registry"]).registry.counter(
+        "etcd_backoff_retries_total", site="_test").get()
+    assert after - before == 2
+
+
+def test_backoff_bad_shape_rejected():
+    with pytest.raises(ValueError):
+        Backoff(base=0.0)
+    with pytest.raises(ValueError):
+        Backoff(base=1.0, cap=0.5)
+
+
+# -- WAL failure semantics ---------------------------------------------------
+
+
+def _mk_wal(tmp_path):
+    from etcd_tpu.wal.wal import WAL
+    from etcd_tpu.wire import Entry, HardState
+
+    w = WAL.create(str(tmp_path / "wal"), b"meta")
+    w.save(HardState(), [Entry(index=0, term=0, data=b"boot")])
+    return w
+
+
+def _save(w, idx, data):
+    from etcd_tpu.wire import Entry, HardState
+
+    w.save(HardState(term=1, vote=0, commit=idx),
+           [Entry(index=idx, term=1, data=data)])
+
+
+def test_wal_injected_enospc_rolls_back_and_recovers(tmp_path):
+    from etcd_tpu.wal.wal import WAL
+
+    w = _mk_wal(tmp_path)
+    _save(w, 1, b"a")
+    faults_mod.FAULTS.configure("wal.append=enospc(times=2)")
+    with pytest.raises(EtcdNoSpace) as ei:
+        _save(w, 2, b"b")
+    assert ei.value.error_code == ECODE_NO_SPACE
+    # the probe exercises the same seam: refused while armed,
+    # clean once the times budget is spent
+    with pytest.raises(EtcdNoSpace):
+        w.probe_space()
+    w.probe_space()
+    faults_mod.FAULTS.configure("")
+    _save(w, 2, b"b")
+    w.close()
+    w2 = WAL.open_at_index(str(tmp_path / "wal"), 0)
+    _md, st, ents = w2.read_all()
+    assert [(e.index, e.data) for e in ents] == [
+        (0, b"boot"), (1, b"a"), (2, b"b")]
+    assert st.commit == 2
+    w2.close()
+
+
+def test_wal_fsync_enospc_rolls_back_to_pre_batch_mark(
+        tmp_path, monkeypatch):
+    """A real full disk surfacing at FSYNC time (delayed allocation)
+    must also roll back: truncate below the pages whose writeback
+    the kernel may have dropped, then keep appending cleanly."""
+    import etcd_tpu.wal.wal as walmod
+    from etcd_tpu.wal.wal import WAL
+
+    w = _mk_wal(tmp_path)
+    real_fsync = os.fsync
+    state = {"fail": True}
+
+    def oneshot(fd):
+        if state["fail"]:
+            state["fail"] = False
+            raise OSError(errno.ENOSPC, "disk full")
+        return real_fsync(fd)
+
+    monkeypatch.setattr(walmod.os, "fsync", oneshot)
+    with pytest.raises(EtcdNoSpace):
+        _save(w, 1, b"doomed")
+    monkeypatch.setattr(walmod.os, "fsync", real_fsync)
+    _save(w, 1, b"kept")
+    w.close()
+    w2 = WAL.open_at_index(str(tmp_path / "wal"), 0)
+    _md, _st, ents = w2.read_all()
+    assert [(e.index, e.data) for e in ents] == [
+        (0, b"boot"), (1, b"kept")]
+    w2.close()
+
+
+def test_wal_fsync_eio_is_fail_stop(tmp_path):
+    """An fsync EIO never returns control to the save path: the
+    fail-stop hook fires and the save NEVER completes (no ack can
+    follow)."""
+    w = _mk_wal(tmp_path)
+    faults_mod.FAULTS.configure("wal.fsync=err(EIO,once)")
+    got = []
+    prev = faults_mod.set_fail_stop(
+        lambda reason, exc: got.append((reason, exc)))
+    try:
+        with pytest.raises(faults_mod.FailStopError):
+            _save(w, 1, b"never-acked")
+    finally:
+        faults_mod.set_fail_stop(prev)
+    assert len(got) == 1 and "fsync" in got[0][0]
+
+
+_WAL_EIO_CHILD = """
+import sys
+sys.path.insert(0, {repo!r})
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["ETCD_FAULTS"] = "wal.fsync=err(EIO,after=2)"
+os.environ["ETCD_FLIGHT_DIR"] = {dump!r}
+from etcd_tpu.wal.wal import WAL
+from etcd_tpu.wire import Entry, HardState
+w = WAL.create({wal!r}, b"meta")
+w.save(HardState(), [Entry(index=0, term=0, data=b"boot")])
+print("ACK1", flush=True)
+w.save(HardState(term=1, vote=0, commit=1),
+       [Entry(index=1, term=1, data=b"x")])
+print("ACK2", flush=True)
+"""
+
+
+def test_fail_stop_exits_process_with_distinct_code(tmp_path):
+    """Subprocess proof at the WAL layer: the armed EIO turns the
+    second save into a process exit with FAIL_STOP_EXIT, and the
+    post-EIO ack line is never printed."""
+    out = subprocess.run(
+        [sys.executable, "-c", _WAL_EIO_CHILD.format(
+            repo=REPO, wal=str(tmp_path / "w"),
+            dump=str(tmp_path / "fl"))],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == FAIL_STOP_EXIT, out.stderr
+    assert "ACK1" in out.stdout
+    assert "ACK2" not in out.stdout
+
+
+# -- fsync-EIO fail-stop on all three server tiers ---------------------------
+
+_DIST_TIER_CHILD = """
+import sys
+sys.path.insert(0, {repo!r})
+import os, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["ETCD_FLIGHT_DIR"] = {dump!r}
+from etcd_tpu.server.distserver import DistServer
+from etcd_tpu.wire.requests import Request
+from etcd_tpu.utils import faults
+srv = DistServer({data!r}, slot=0,
+                 peer_urls=["http://127.0.0.1:{port}"], g=4,
+                 election=8, tick_interval=0.02, cap=64)
+srv.start()
+deadline = time.time() + 30
+while time.time() < deadline and not srv.mr.is_leader().all():
+    srv._campaign(~srv.mr.is_leader()); time.sleep(0.2)
+srv.do(Request(method="PUT", id=2, path="/a", val="1"), timeout=15)
+print("ACK1", flush=True)
+faults.FAULTS.configure("wal.fsync=err(EIO,once)")
+try:
+    srv.do(Request(method="PUT", id=3, path="/a", val="2"),
+           timeout=15)
+    print("ACK2", flush=True)
+except Exception as e:
+    print("ERR", type(e).__name__, flush=True)
+time.sleep(1)
+"""
+
+_MG_TIER_CHILD = """
+import sys
+sys.path.insert(0, {repo!r})
+import os, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["ETCD_FLIGHT_DIR"] = {dump!r}
+from etcd_tpu.server.multigroup import MultiGroupServer
+from etcd_tpu.wire.requests import Request
+from etcd_tpu.utils import faults
+srv = MultiGroupServer({data!r}, g=4, m=1, spare_member_slots=0,
+                       cap=64, tick_interval=0.02)
+srv.start()
+srv.do(Request(method="PUT", id=2, path="/a", val="1"), timeout=20)
+print("ACK1", flush=True)
+faults.FAULTS.configure("wal.fsync=err(EIO,once)")
+try:
+    srv.do(Request(method="PUT", id=3, path="/a", val="2"),
+           timeout=15)
+    print("ACK2", flush=True)
+except Exception as e:
+    print("ERR", type(e).__name__, flush=True)
+time.sleep(1)
+"""
+
+_CLASSIC_TIER_CHILD = """
+import sys
+sys.path.insert(0, {repo!r})
+import os, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["ETCD_FLIGHT_DIR"] = {dump!r}
+from etcd_tpu.server.cluster import Cluster
+from etcd_tpu.server.config import ServerConfig
+from etcd_tpu.server.server import new_server, gen_id
+from etcd_tpu.wire.requests import Request
+from etcd_tpu.utils import faults
+cluster = Cluster()
+cluster.set_from_string("solo=http://127.0.0.1:{port}")
+cfg = ServerConfig(name="solo", data_dir={data!r}, cluster=cluster)
+srv = new_server(cfg)
+srv.tick_interval = 0.01
+srv._start()
+deadline = time.time() + 30
+while time.time() < deadline:
+    try:
+        srv.do(Request(method="PUT", id=gen_id(), path="/a",
+                       val="1"), timeout=2)
+        break
+    except Exception:
+        time.sleep(0.2)
+print("ACK1", flush=True)
+faults.FAULTS.configure("wal.fsync=err(EIO,once)")
+try:
+    srv.do(Request(method="PUT", id=gen_id(), path="/a", val="2"),
+           timeout=15)
+    print("ACK2", flush=True)
+except Exception as e:
+    print("ERR", type(e).__name__, flush=True)
+time.sleep(1)
+"""
+
+
+def _run_tier_child(code, tmp_path, **fmt):
+    from conftest import free_ports
+
+    out = subprocess.run(
+        [sys.executable, "-c", code.format(
+            repo=REPO, data=str(tmp_path / "d"),
+            dump=str(tmp_path / "fl"), port=free_ports(1)[0],
+            **fmt)],
+        capture_output=True, text=True, timeout=180)
+    assert out.returncode == FAIL_STOP_EXIT, \
+        (out.returncode, out.stdout[-500:], out.stderr[-2000:])
+    assert "ACK1" in out.stdout, out.stdout
+    # THE invariant: a server that saw fsync fail never acked the
+    # write whose durability that fsync was
+    assert "ACK2" not in out.stdout, out.stdout
+    return out
+
+
+def test_fsync_eio_fail_stop_dist_tier(tmp_path):
+    out = _run_tier_child(_DIST_TIER_CHILD, tmp_path)
+    # the fail-stop dumped the attached flight ring with the
+    # injected-fault evidence
+    dumps = [f for f in os.listdir(tmp_path / "fl")
+             if "failstop" in f]
+    assert len(dumps) == 1
+    import json
+
+    with open(tmp_path / "fl" / dumps[0]) as f:
+        d = json.load(f)
+    faults_evs = [e for e in d["events"] if e["c"] == "fault"]
+    assert [e["point"] for e in faults_evs] == ["wal.fsync"]
+    assert d["events"][-1]["c"] == "failstop"
+
+
+def test_fsync_eio_fail_stop_multigroup_tier(tmp_path):
+    _run_tier_child(_MG_TIER_CHILD, tmp_path)
+
+
+def test_fsync_eio_fail_stop_classic_tier(tmp_path):
+    _run_tier_child(_CLASSIC_TIER_CHILD, tmp_path)
+
+
+# -- NOSPACE enter / serve-reads / recover (dist tier) -----------------------
+
+
+def _solo_dist(tmp_path, **kw):
+    from conftest import free_ports
+    from etcd_tpu.server.distserver import DistServer
+
+    port = free_ports(1)[0]
+    kw.setdefault("election", 8)
+    kw.setdefault("tick_interval", 0.02)
+    kw.setdefault("cap", 64)
+    srv = DistServer(str(tmp_path / "solo"), slot=0,
+                     peer_urls=[f"http://127.0.0.1:{port}"], g=4,
+                     **kw)
+    srv.start()
+    deadline = time.time() + 30
+    while time.time() < deadline and not srv.mr.is_leader().all():
+        srv._campaign(~srv.mr.is_leader())
+        time.sleep(0.2)
+    assert srv.mr.is_leader().all()
+    return srv
+
+
+def _rid():
+    _rid.n += 1
+    return _rid.n
+
+
+_rid.n = 100
+
+
+def test_dist_nospace_cycle(tmp_path):
+    """ENOSPC on the WAL append seam: the server enters read-only
+    NOSPACE mode (writes rejected with ECODE_NO_SPACE, lease reads
+    keep serving), then recovers via the disk probe once the window
+    lapses — accepting writes again, including the held batch that
+    triggered the episode."""
+    from etcd_tpu.wire.requests import Request
+
+    srv = _solo_dist(tmp_path)
+    try:
+        srv.do(Request(method="PUT", id=_rid(), path="/k",
+                       val="v0"), timeout=15)
+        faults_mod.FAULTS.configure("wal.append=enospc(for=1.0s)")
+        # the write that trips the failpoint is HELD, not lost: its
+        # records re-persist at recovery and the ack arrives late
+        held = {}
+
+        def first_write():
+            try:
+                srv.do(Request(method="PUT", id=_rid(), path="/k",
+                               val="v1"), timeout=30)
+                held["ok"] = True
+            except Exception as e:
+                held["err"] = e
+
+        t = threading.Thread(target=first_write, daemon=True)
+        t.start()
+        deadline = time.time() + 10
+        while not srv._nospace and time.time() < deadline:
+            time.sleep(0.02)
+        assert srv._nospace, "server never entered NOSPACE mode"
+        # writes bounce with the DISTINCT code
+        with pytest.raises(EtcdError) as ei:
+            srv.do(Request(method="PUT", id=_rid(), path="/k",
+                           val="v2"), timeout=5)
+        assert ei.value.error_code == ECODE_NO_SPACE
+        # reads keep serving (single-member leader: lease basis is
+        # always fresh) — linearizable default, NOT the opt-out
+        ev = srv.do(Request(method="GET", id=_rid(), path="/k"))
+        assert ev.event.node.value == "v0"
+        # recovery: window lapses, the probe clears the flag, the
+        # held write acks, new writes flow
+        t.join(timeout=30)
+        assert held.get("ok"), held
+        deadline = time.time() + 20
+        while srv._nospace and time.time() < deadline:
+            time.sleep(0.05)
+        assert not srv._nospace, "NOSPACE never recovered"
+        srv.do(Request(method="PUT", id=_rid(), path="/k",
+                       val="v3"), timeout=15)
+        ev = srv.do(Request(method="GET", id=_rid(), path="/k"))
+        assert ev.event.node.value == "v3"
+        # the episode is visible on the wire: gauge returned to 0
+        from etcd_tpu.obs.metrics import registry as obs_registry
+
+        assert obs_registry.gauge("etcd_nospace_active").get() == 0
+    finally:
+        faults_mod.FAULTS.configure("")
+        srv.stop()
+
+
+def test_dist_nospace_restart_replays_cleanly(tmp_path):
+    """A NOSPACE episode must leave a replayable WAL: the rolled-back
+    and re-persisted records restart into exactly the acked state."""
+    from etcd_tpu.server.distserver import DistServer
+    from etcd_tpu.wire.requests import Request
+
+    srv = _solo_dist(tmp_path)
+    port_url = srv.peer_urls
+    try:
+        srv.do(Request(method="PUT", id=_rid(), path="/r",
+                       val="a"), timeout=15)
+        faults_mod.FAULTS.configure("wal.append=enospc(for=0.5s)")
+        srv.do(Request(method="PUT", id=_rid(), path="/r",
+                       val="b"), timeout=30)  # held, acked late
+        faults_mod.FAULTS.configure("")
+        deadline = time.time() + 20
+        while srv._nospace and time.time() < deadline:
+            time.sleep(0.05)
+        srv.do(Request(method="PUT", id=_rid(), path="/r",
+                       val="c"), timeout=15)
+    finally:
+        faults_mod.FAULTS.configure("")
+        srv.stop()
+    srv2 = DistServer(str(tmp_path / "solo"), slot=0,
+                      peer_urls=port_url, g=4, election=8,
+                      tick_interval=0.02, cap=64)
+    srv2.start()
+    try:
+        # the acked tail above the last persisted frontier re-commits
+        # once the restarted member re-elects (normal restart
+        # semantics) — what must NEVER be missing is the acked "c"
+        # from the replayed log
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if not srv2.mr.is_leader().all():
+                srv2._campaign(~srv2.mr.is_leader())
+            try:
+                if srv2.store.get("/r", False,
+                                  False).node.value == "c":
+                    break
+            except EtcdError:
+                pass
+            time.sleep(0.2)
+        assert srv2.store.get("/r", False, False).node.value == "c"
+    finally:
+        srv2.stop()
+
+
+# -- asymmetric partition: check-quorum step-down ----------------------------
+
+
+def test_one_way_partition_leader_steps_down_no_stale_reads(
+        tmp_path):
+    """A leader whose outbound heartbeats deliver but whose inbound
+    acks are all dropped must abdicate within the check-quorum
+    window (else the cluster wedges forever: followers' timers keep
+    resetting while nothing commits).  After the step-down a new
+    leader serves writes, and the deposed node's default reads FAIL
+    CLOSED rather than serve the overwritten value."""
+    from conftest import bootstrap_dist_leader, make_dist_cluster
+    from etcd_tpu.wire.requests import Request
+
+    servers, _ports = make_dist_cluster(
+        tmp_path, m=3, g=4, election=20, tick_interval=0.05,
+        post_timeout=1.0, lease_ticks=8)
+    try:
+        bootstrap_dist_leader(servers)
+        servers[0].do(Request(method="PUT", id=_rid(), path="/p",
+                              val="old"), timeout=15)
+        # drop EVERYTHING inbound at s0: pushed frames at its
+        # handler AND ack/vote responses on its own channels
+        faults_mod.FAULTS.configure("peerlink.recv[*->s0]=drop()")
+        # check-quorum: down_s = 2 * (2*20) * 0.05 = 4s
+        deadline = time.time() + 25
+        while time.time() < deadline \
+                and servers[0].mr.is_leader().any():
+            time.sleep(0.2)
+        assert not servers[0].mr.is_leader().any(), \
+            "partitioned leader never stepped down"
+        # a reachable leader emerges and commits a NEW value
+        deadline = time.time() + 40
+        committed = False
+        while time.time() < deadline and not committed:
+            for s in servers[1:]:
+                try:
+                    s.do(Request(method="PUT", id=_rid(),
+                                 path="/p", val="new"), timeout=3)
+                    committed = True
+                    break
+                except Exception:
+                    pass
+        assert committed, "no new leader became writable"
+        # the deposed node cannot confirm reads: default GET fails
+        # closed (never serves the quorum-overwritten "old")
+        try:
+            ev = servers[0].do(Request(method="GET", id=_rid(),
+                                       path="/p"), timeout=3)
+            assert ev.event.node.value == "new"
+        except (TimeoutError, EtcdError):
+            pass  # fail-closed is the expected outcome
+        # heal: cleared faults let s0 rejoin and converge
+        faults_mod.FAULTS.configure("")
+        deadline = time.time() + 40
+        while time.time() < deadline:
+            try:
+                v = servers[0].do(
+                    Request(method="GET", id=_rid(), path="/p",
+                            serializable=True)).event.node.value
+                if v == "new":
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        assert servers[0].store.get(
+            "/p", False, False).node.value == "new"
+    finally:
+        faults_mod.FAULTS.configure("")
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+
+
+def test_delayed_acks_expire_lease_reads_fail_closed(tmp_path):
+    """Satellite: delay-injected ack loss must EXPIRE the lease —
+    a default GET on the cut-off leader either fails closed or
+    serves a confirmed value, never the stale one silently.  Unit
+    form: feed the lease clock directly and assert the serve gate
+    closes once the basis goes stale."""
+    import numpy as np
+
+    from etcd_tpu.ops.quorum import quorum_basis
+    from etcd_tpu.server.readindex import LeaseClock
+
+    g, m = 2, 3
+    lc = LeaseClock(g, m, slot=0)
+    members = np.ones((g, m), bool)
+    nmembers = np.full(g, 3)
+    t0 = 100.0
+    lc.note_ack(1, t0, np.ones(g, bool))
+    lc.note_ack(2, t0, np.ones(g, bool))
+    lease_s = 0.5
+    # fresh acks: basis now-ish, lease valid
+    b = quorum_basis(lc.ack_t0, members, nmembers, 0, t0 + 0.1)
+    assert (b + lease_s > t0 + 0.1).all()
+    # delayed/dropped acks: the basis STAYS at the last real ack —
+    # the self-slot "now" can never outvote the quorum — and the
+    # lease check fails once now passes basis + lease_s
+    b = quorum_basis(lc.ack_t0, members, nmembers, 0, t0 + 1.0)
+    assert (b == t0).all()
+    assert not (b + lease_s > t0 + 1.0).any()
+
+
+# -- peerlink reconnect backoff regression -----------------------------------
+
+
+def test_peerlink_reconnect_backs_off_under_persistent_failure():
+    """Satellite fix: a persistently unreachable peer used to be
+    retried on a flat 50ms loop.  With the shared backoff the
+    connect attempts must space out exponentially — bounded attempts
+    inside a fixed window."""
+    from conftest import free_ports
+    from etcd_tpu.server.peerlink import PipeChannel
+
+    port = free_ports(1)[0]  # nothing listens: instant refusal
+    fails = []
+    done = threading.Event()
+
+    chan = PipeChannel(f"http://127.0.0.1:{port}", "/x",
+                       timeout=0.2,
+                       on_fail=lambda seqs, reason:
+                       (fails.append((time.monotonic(), seqs)),
+                        None if done.is_set()
+                        else chan.send(seqs[0], b"p")),
+                       name="bk")
+    try:
+        chan.send(1, b"p")
+        time.sleep(2.5)
+        done.set()
+    finally:
+        chan.close()
+    # flat 50ms pacing would retry ~50 times in 2.5s; the jittered
+    # exponential (0 + 0.05 * 2^k, +/-50%) stays in single digits
+    n = len([t for t, _ in fails if t <= fails[0][0] + 2.5])
+    assert 2 <= n <= 15, (n, "reconnect pacing looks flat")
+
+
+def test_pipe_channel_drop_is_silent_loss():
+    """A peerlink.send drop must not surface as on_fail — silent
+    loss is the point (only the caller's expire sweep recovers)."""
+    from conftest import free_ports
+    from etcd_tpu.server.peerlink import PipeChannel
+
+    port = free_ports(1)[0]
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", port))
+    srv.listen(4)
+    got_fail = []
+    got_resp = []
+    faults_mod.FAULTS.configure(
+        "peerlink.send[sA->sB]=drop(times=1)")
+    chan = PipeChannel(f"http://127.0.0.1:{port}", "/x",
+                       timeout=0.5,
+                       on_resp=lambda s, st, b:
+                       got_resp.append(s),
+                       on_fail=lambda seqs, r:
+                       got_fail.append((seqs, r)),
+                       fault_ctx=("sA", "sB"), name="drop")
+    try:
+        chan.send(1, b"payload")
+        time.sleep(0.6)
+        assert got_fail == [] and got_resp == []
+        # nothing ever reached the socket
+        srv.settimeout(0.2)
+        with pytest.raises(socket.timeout):
+            srv.accept()
+    finally:
+        faults_mod.FAULTS.configure("")
+        chan.close()
+        srv.close()
+
+
+# -- classic & multigroup NOSPACE write rejection ----------------------------
+
+
+def test_multigroup_nospace_write_rejection_and_recovery(tmp_path):
+    from etcd_tpu.server.multigroup import MultiGroupServer
+    from etcd_tpu.wire.requests import Request
+
+    srv = MultiGroupServer(str(tmp_path / "mg"), g=4, m=1,
+                           spare_member_slots=0, cap=64,
+                           tick_interval=0.02)
+    srv.start()
+    try:
+        srv.do(Request(method="PUT", id=_rid(), path="/m",
+                       val="a"), timeout=20)
+        faults_mod.FAULTS.configure("wal.append=enospc(for=0.8s)")
+        held = {}
+
+        def first_write():
+            try:
+                srv.do(Request(method="PUT", id=_rid(), path="/m",
+                               val="b"), timeout=30)
+                held["ok"] = True
+            except Exception as e:
+                held["err"] = e
+
+        t = threading.Thread(target=first_write, daemon=True)
+        t.start()
+        deadline = time.time() + 10
+        while not srv._nospace and time.time() < deadline:
+            time.sleep(0.02)
+        assert srv._nospace
+        with pytest.raises(EtcdError) as ei:
+            srv.do(Request(method="PUT", id=_rid(), path="/m",
+                           val="c"), timeout=5)
+        assert ei.value.error_code == ECODE_NO_SPACE
+        # reads serve throughout (shared-store cohosted read)
+        ev = srv.do(Request(method="GET", id=_rid(), path="/m"))
+        assert ev.event.node.value == "a"
+        t.join(timeout=30)
+        assert held.get("ok"), held
+        deadline = time.time() + 20
+        while srv._nospace and time.time() < deadline:
+            time.sleep(0.05)
+        assert not srv._nospace
+        srv.do(Request(method="PUT", id=_rid(), path="/m",
+                       val="d"), timeout=20)
+    finally:
+        faults_mod.FAULTS.configure("")
+        srv.stop()
+
+
+# -- fsio.fsync seam (snapshotter route) -------------------------------------
+
+
+def test_snapshotter_fsync_seam_enospc_and_eio(tmp_path):
+    """The snapshotter's file fsync rides fsio.fsync: injected
+    ENOSPC removes the partial .snap and raises EtcdNoSpace (older
+    durable snapshots remain loadable); injected EIO is fail-stop."""
+    from etcd_tpu.snap.snapshotter import Snapshotter
+    from etcd_tpu.wire import Snapshot
+
+    d = str(tmp_path / "snap")
+    os.makedirs(d)
+    ss = Snapshotter(d)
+    ss.save_snap(Snapshot(data=b"good", index=1, term=1))
+    faults_mod.FAULTS.configure("fsio.fsync=enospc(once)")
+    with pytest.raises(EtcdNoSpace):
+        ss.save_snap(Snapshot(data=b"doomed", index=2, term=1))
+    # the partial file is gone; the older snapshot still loads
+    assert [n for n in os.listdir(d) if n.endswith(".snap")] \
+        == ["0000000000000001-0000000000000001.snap"]
+    assert ss.load().data == b"good"
+    faults_mod.FAULTS.configure("fsio.fsync=err(EIO,once)")
+    got = []
+    prev = faults_mod.set_fail_stop(
+        lambda reason, exc: got.append(reason))
+    try:
+        with pytest.raises(faults_mod.FailStopError):
+            ss.save_snap(Snapshot(data=b"x", index=3, term=1))
+    finally:
+        faults_mod.set_fail_stop(prev)
+    assert got and "fsync" in got[0]

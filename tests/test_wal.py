@@ -504,3 +504,54 @@ def test_gc_never_removes_append_segment(tmp_path):
     _, _, ents = w2.read_all()
     assert [e.index for e in ents] == [0, 1, 2]
     w2.close()
+
+
+# -- fault seams (PR 10) -----------------------------------------------------
+
+
+def test_probe_space_is_a_noop_on_a_healthy_wal(tmp_path):
+    """The NOSPACE recovery probe writes no record: byte-identical
+    segment before and after, and the stream replays unchanged."""
+    d = str(tmp_path / "wal")
+    w = WAL.create(d, b"meta")
+    w.save(HardState(term=1, vote=0, commit=1), [ent(0, term=0),
+                                                 ent(1)])
+    seg = os.path.join(d, sorted(os.listdir(d))[0])
+    before = open(seg, "rb").read()
+    w.probe_space()
+    assert open(seg, "rb").read() == before
+    w.save(HardState(term=1, vote=0, commit=2), [ent(2)])
+    w.close()
+    w2 = WAL.open_at_index(d, 0)
+    _, st, ents = w2.read_all()
+    assert [e.index for e in ents] == [0, 1, 2] and st.commit == 2
+    w2.close()
+
+
+def test_cut_and_gc_cross_their_failpoints(tmp_path):
+    """wal.cut / wal.gc are injectable seams: an armed err surfaces
+    typed (EtcdNoSpace for ENOSPC at cut) and the WAL keeps working
+    once cleared."""
+    from etcd_tpu.utils import faults as faults_mod
+    from etcd_tpu.utils.errors import EtcdNoSpace
+
+    d = str(tmp_path / "wal")
+    w = WAL.create(d, b"meta")
+    w.save(HardState(term=1, vote=0, commit=1), [ent(0, term=0),
+                                                 ent(1)])
+    try:
+        faults_mod.FAULTS.configure("wal.cut=enospc(once)")
+        with pytest.raises(EtcdNoSpace):
+            w.cut()
+        faults_mod.FAULTS.configure("wal.gc=err(EIO,once)")
+        with pytest.raises(OSError):
+            w.gc(1)
+    finally:
+        faults_mod.FAULTS.configure("")
+    w.cut()
+    w.save(HardState(term=1, vote=0, commit=2), [ent(2)])
+    w.close()
+    w2 = WAL.open_at_index(d, 0)
+    _, _, ents = w2.read_all()
+    assert [e.index for e in ents] == [0, 1, 2]
+    w2.close()
