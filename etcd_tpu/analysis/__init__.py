@@ -40,6 +40,10 @@ that cost whole rounds and that the 6-minute suite cannot see:
 - **timeout-bands** (timeouts.py): ``election >= m`` and
   ``heartbeat < election`` at every config surface — constructor
   call sites AND argparse flag defaults (PR 4).
+- **bounded-queue** (boundedq.py): ``queue.Queue()``/``deque()``
+  constructed without a bound on the server/store hot paths — the
+  PR-9 BoundedEventQueue lesson as a rule; external bounds need a
+  baseline justification (PR 12).
 
 Since PR 4 the suite is **whole-program**: ``callgraph.py`` builds a
 project import/call graph once per run (cached on the engine's
@@ -58,6 +62,7 @@ anywhere the repo imports.
 """
 
 from .boundary import DeviceBoundaryChecker
+from .boundedq import BoundedQueueChecker
 from .callgraph import CallGraph
 from .durability import DurabilityOrderingChecker
 from .engine import (
@@ -90,12 +95,14 @@ ALL_CHECKERS = (
     StaticShapeChecker(),
     SeqContiguityChecker(),
     TimeoutBandChecker(),
+    BoundedQueueChecker(),
 )
 
 __all__ = [
     "ALL_CHECKERS",
     "AnalysisContext",
     "Baseline",
+    "BoundedQueueChecker",
     "CallGraph",
     "DeviceBoundaryChecker",
     "DurabilityOrderingChecker",

@@ -1,0 +1,100 @@
+"""bounded-queue: hot-path queues must declare a bound.
+
+The PR-9 lesson, made a checker: an unbounded ``queue.Queue()`` or
+``collections.deque()`` between a producer that can outrun its
+consumer is a memory-exhaustion bug with a delay fuse — the watch
+fanout's 1 KiB-per-watcher ``queue.Queue`` was replaced by the
+slotted BoundedEventQueue precisely because "the queue grows until
+the process dies" is not a policy.  On the server/store hot paths
+every queue constructor must either pass an explicit bound
+(``maxsize=``/``maxlen=``) or carry a baseline justification naming
+the external bound (a pipeline-depth window, a capacity check in the
+owning class, a drain-before-produce protocol).
+
+Flagged shapes (string-resolvable constructors only):
+
+- ``queue.Queue()`` / ``Queue()`` with no ``maxsize``, or a literal
+  ``maxsize`` <= 0 (the stdlib's "0 means infinite" footgun);
+- ``queue.SimpleQueue()`` — unbounded by construction;
+- ``deque()`` / ``collections.deque(iterable)`` without ``maxlen``.
+
+A non-literal bound (``maxsize=n``) is trusted: the policy decision
+exists in code, which is what the rule is for.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Checker, Finding, dotted_name, scope_map
+
+_QUEUE_NAMES = {"Queue", "LifoQueue", "PriorityQueue"}
+
+
+def _bound_arg(node: ast.Call, kw_name: str, pos: int):
+    """The bound argument node, or None when absent."""
+    for kw in node.keywords:
+        if kw.arg == kw_name:
+            return kw.value
+    if len(node.args) > pos:
+        return node.args[pos]
+    return None
+
+
+def _literal_nonpositive(arg: ast.AST) -> bool:
+    return isinstance(arg, ast.Constant) \
+        and isinstance(arg.value, (int, float)) \
+        and not isinstance(arg.value, bool) and arg.value <= 0
+
+
+class BoundedQueueChecker(Checker):
+    name = "bounded-queue"
+    targets = ("etcd_tpu/server/", "etcd_tpu/store/")
+
+    def check(self, relpath: str, tree: ast.AST, source: str,
+              root: str | None = None, ctx=None) -> list[Finding]:
+        owner = scope_map(tree)
+        out: list[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if not name:
+                continue
+            last = name.rsplit(".", 1)[-1]
+            scope = owner.get(node, "")
+            if last == "SimpleQueue":
+                out.append(Finding(
+                    checker=self.name, path=relpath,
+                    line=node.lineno, rule="unbounded-queue",
+                    scope=scope,
+                    message=f"{name}() is unbounded by construction "
+                            f"— use a bounded queue on hot paths",
+                    detail=last))
+            elif last in _QUEUE_NAMES:
+                bound = _bound_arg(node, "maxsize", 0)
+                if bound is None or _literal_nonpositive(bound):
+                    out.append(Finding(
+                        checker=self.name, path=relpath,
+                        line=node.lineno, rule="unbounded-queue",
+                        scope=scope,
+                        message=f"{name}() without a positive "
+                                f"maxsize is unbounded (stdlib "
+                                f"maxsize<=0 means infinite) — pass "
+                                f"an explicit bound or justify the "
+                                f"external one in the baseline",
+                        detail=last))
+            elif last == "deque":
+                bound = _bound_arg(node, "maxlen", 1)
+                if bound is None \
+                        or (isinstance(bound, ast.Constant)
+                            and bound.value is None):
+                    out.append(Finding(
+                        checker=self.name, path=relpath,
+                        line=node.lineno, rule="unbounded-queue",
+                        scope=scope,
+                        message=f"{name}() without maxlen is "
+                                f"unbounded — pass maxlen or justify "
+                                f"the external bound in the baseline",
+                        detail=last))
+        return out

@@ -58,32 +58,59 @@ class Client:
         skipped.  With ``retries`` set, a fully-failed endpoint
         sweep re-runs after a shared jittered-backoff wait (an
         answered-but-erroring endpoint still fails fast: an HTTP
-        error is an answer, not an outage)."""
+        error is an answer, not an outage).
+
+        Exception to fail-fast (PR 12): a 429/503 carrying
+        ``Retry-After`` is an admission-control shed.  It retries the
+        SAME endpoint after honoring the server's pacing hint (floored
+        by the shared jittered backoff, billed to
+        ``etcd_backoff_retries_total{site="admission"}``) — failing
+        over a shed request to another node defeats the shed and turns
+        one overloaded member into a cluster-wide retry storm."""
         last_err: Exception = ClientError(0, "no endpoints tried")
         backoff = None
+        admission_backoff = None
+        shed_budget = self.retries
         for sweep in range(self.retries + 1):
             for ep in self.endpoints:
                 url = ep + path
                 if params:
                     url += "?" + urllib.parse.urlencode(params)
-                req = urllib.request.Request(url, data=data,
-                                             method=method)
-                if content_type:
-                    req.add_header("Content-Type", content_type)
-                try:
-                    return urllib.request.urlopen(
-                        req, timeout=timeout or self.timeout,
-                        context=self._ssl)
-                except urllib.error.HTTPError as e:
-                    body = e.read().decode()
+                while True:
+                    req = urllib.request.Request(url, data=data,
+                                                 method=method)
+                    if content_type:
+                        req.add_header("Content-Type", content_type)
                     try:
-                        parsed = json.loads(body)
-                    except json.JSONDecodeError:
-                        parsed = body
-                    raise ClientError(e.code, parsed) from None
-                except (urllib.error.URLError, OSError) as e:
-                    last_err = e
-                    continue
+                        return urllib.request.urlopen(
+                            req, timeout=timeout or self.timeout,
+                            context=self._ssl)
+                    except urllib.error.HTTPError as e:
+                        body = e.read().decode()
+                        try:
+                            parsed = json.loads(body)
+                        except json.JSONDecodeError:
+                            parsed = body
+                        retry_after = e.headers.get("Retry-After") \
+                            if e.headers else None
+                        if e.code in (429, 503) and retry_after \
+                                and shed_budget > 0:
+                            shed_budget -= 1
+                            if admission_backoff is None:
+                                admission_backoff = Backoff(
+                                    base=0.25, cap=30.0,
+                                    site="admission")
+                            try:
+                                hint = float(retry_after)
+                            except ValueError:
+                                hint = 0.0
+                            time.sleep(max(hint,
+                                           admission_backoff.next()))
+                            continue  # same endpoint, paced
+                        raise ClientError(e.code, parsed) from None
+                    except (urllib.error.URLError, OSError) as e:
+                        last_err = e
+                        break  # next endpoint
             if sweep < self.retries:
                 if backoff is None:
                     backoff = Backoff(base=0.25, cap=5.0,

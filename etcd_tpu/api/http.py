@@ -20,6 +20,7 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..server import EtcdServer, gen_id
+from ..server.frontdoor import LISTEN_BACKLOG
 from ..utils import faults as _faults
 from ..utils.errors import (
     ECODE_INDEX_NAN,
@@ -674,6 +675,10 @@ class EtcdRequestHandler(BaseHTTPRequestHandler):
 class _Server(ThreadingHTTPServer):
     daemon_threads = True
     allow_reuse_address = True
+    # centralized client/peer backlog (PR 12): the socketserver
+    # default of 5 RSTs a connection burst in the kernel before
+    # admission control can answer 429
+    request_queue_size = LISTEN_BACKLOG
 
 
 def _make_handler_class(etcd: EtcdServer, mode: str,

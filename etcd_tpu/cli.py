@@ -72,6 +72,14 @@ def build_parser() -> argparse.ArgumentParser:
                    default="http://localhost:2379,http://localhost:4001")
     p.add_argument("--cors", default="",
                    help="Comma-separated white list of origins for CORS")
+    p.add_argument("--frontdoor",
+                   default=os.environ.get("ETCD_FRONTDOOR", "on"),
+                   choices=["on", "off"],
+                   help="Serve the client API through the event-"
+                        "driven front door (admission control, "
+                        "per-tenant quotas, 50k-connection scale; "
+                        "PR 12). 'off' falls back to the threaded "
+                        "server")
     p.add_argument("--proxy", default=PROXY_VALUE_OFF,
                    choices=list(PROXY_VALUES))
     p.add_argument("--ca-file", default="")
@@ -320,12 +328,12 @@ def start_dist(args, explicit: set[str]) -> int:
 
         s._campaign(np.ones(g, bool))
     cors = parse_cors(args.cors) if args.cors else None
-    ch = make_client_handler(s, cors=cors)
     lcurls = urls_from_flags(args, "listen_client_urls", "bind_addr",
                              explicit, client_tls.empty())
     for u in lcurls:
         host, port = _split_hostport(u)
-        serve(ch, host, port, new_listener_context(client_tls))
+        _serve_client(args, s, cors, host, port,
+                      new_listener_context(client_tls))
         log.info("Listening for client requests on %s (dist slot "
                  "%d/%d, %d groups)", u, args.dist_slot, len(peers), g)
 
@@ -358,12 +366,12 @@ def start_multigroup(args, explicit: set[str]) -> int:
         client_urls=list(acurls), mesh=mesh)
     s.start()
     cors = parse_cors(args.cors) if args.cors else None
-    ch = make_client_handler(s, cors=cors)
     lcurls = urls_from_flags(args, "listen_client_urls", "bind_addr",
                              explicit, client_tls.empty())
     for u in lcurls:
         host, port = _split_hostport(u)
-        serve(ch, host, port, new_listener_context(client_tls))
+        _serve_client(args, s, cors, host, port,
+                      new_listener_context(client_tls))
         log.info("Listening for client requests on %s "
                  "(%d co-hosted groups x %d members)",
                  u, args.cohosted_groups, args.cohosted_members)
@@ -407,7 +415,6 @@ def start_etcd(args, cluster: Cluster, explicit: set[str]) -> int:
     s.start()
 
     cors = parse_cors(args.cors) if args.cors else None
-    ch = make_client_handler(s, cors=cors)
     ph = make_peer_handler(s)
 
     lpurls = urls_from_flags(args, "listen_peer_urls", "peer_bind_addr",
@@ -421,7 +428,8 @@ def start_etcd(args, cluster: Cluster, explicit: set[str]) -> int:
                              explicit, client_tls.empty())
     for u in lcurls:
         host, port = _split_hostport(u)
-        serve(ch, host, port, new_listener_context(client_tls))
+        _serve_client(args, s, cors, host, port,
+                      new_listener_context(client_tls))
         log.info("Listening for client requests on %s", u)
 
     _block_forever()
@@ -458,6 +466,19 @@ def start_proxy(args, cluster: Cluster, explicit: set[str]) -> int:
 
     _block_forever()
     return 0
+
+
+def _serve_client(args, s, cors, host: str, port: int, ssl_context):
+    """One client listener: the event-driven front door by default,
+    the threaded server with --frontdoor=off (or under TLS, where the
+    front door itself falls back)."""
+    if args.frontdoor == "on":
+        from .server.frontdoor import serve_frontdoor
+
+        return serve_frontdoor(s, host, port, ssl_context=ssl_context,
+                               cors=cors)
+    return serve(make_client_handler(s, cors=cors), host, port,
+                 ssl_context)
 
 
 def _local_mesh(n: int, groups: int):

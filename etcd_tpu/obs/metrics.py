@@ -286,7 +286,29 @@ _DEFS = (
         "by site: peerlink (pipe-channel reconnect pacing) | "
         "snap_pull (streamed snapshot pull re-arm) | client (API "
         "client endpoint-sweep failover) | nospace_probe (NOSPACE "
-        "recovery probe).", labels=("site",)),
+        "recovery probe) | admission (API client honoring a 429/503 "
+        "Retry-After shed answer on the same endpoint).",
+        labels=("site",)),
+    MetricDef(
+        "etcd_admission_total", "counter",
+        "Front-door admission decisions (server/frontdoor.py), by "
+        "outcome (admit | shed_write | shed_all | close) and reason "
+        "(ok | tenant_rate | tenant_inflight | global_inflight | "
+        "queue_depth | conn_ceiling).  Every client request and "
+        "accepted connection crosses exactly one decision.",
+        labels=("outcome", "reason")),
+    MetricDef(
+        "etcd_tenant_inflight", "gauge",
+        "Requests currently admitted and executing per tenant "
+        "(frontdoor inflight accounting).  Label cardinality is "
+        "bounded: past TENANT_LABEL_MAX distinct tenants, further "
+        "tenants aggregate under the reserved '_other' label.",
+        labels=("tenant",)),
+    MetricDef(
+        "etcd_conns_open", "gauge",
+        "Client connections currently owned by the event-driven "
+        "front door (accept increments, close/eviction decrements; "
+        "the conn-ceiling close decision caps it)."),
     MetricDef(
         "etcd_nospace_active", "gauge",
         "1 while this server is in read-only NOSPACE mode (ENOSPC "

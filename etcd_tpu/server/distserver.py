@@ -63,6 +63,7 @@ from ..snap.stream import (
 from ..store import Store
 from ..utils import faults as _faults
 from ..utils.backoff import Backoff
+from .frontdoor import LISTEN_BACKLOG
 from ..utils.errors import EtcdError, EtcdNoSpace
 from ..utils.trace import tracer
 from ..utils.wait import Chan, Wait
@@ -3284,9 +3285,10 @@ class _PeerHTTPServer(ThreadingHTTPServer):
     drops SYNs (= connection resets) the moment a read-heavy client
     pool opens its connections together — the PR 7 get_many lane
     serves dozens of concurrent client connections, not just the
-    two peer hosts."""
+    two peer hosts.  Backlog is centralized in the front door
+    (PR 12) so the peer/client asymmetry cannot reappear."""
 
-    request_queue_size = 128
+    request_queue_size = LISTEN_BACKLOG
 
 
 def pack_requests(reqs: list[Request]) -> bytes:

@@ -48,6 +48,12 @@ ECODE_INVALID_REMOVE_DELAY = 404
 # serves reads but rejects writes until GC frees space (the NOSPACE
 # alarm of the reference lineage, as a v2-style numeric code)
 ECODE_NO_SPACE = 405
+# Overload shedding (PR 12): the front door's admission control
+# rejected the request — a tenant's token bucket / inflight quota or
+# a global ceiling is exhausted.  Maps to HTTP 429; the response
+# carries Retry-After so well-behaved clients pace instead of
+# retry-storming (api/client.py honors it via the shared backoff).
+ECODE_OVER_CAPACITY = 406
 
 # client related errors
 ECODE_CLIENT_INTERNAL = 500
@@ -82,6 +88,7 @@ ERROR_MESSAGES = {
     ECODE_INVALID_ACTIVE_SIZE: "Invalid active size",
     ECODE_INVALID_REMOVE_DELAY: "Standby remove delay",
     ECODE_NO_SPACE: "No space on data disk; member is read-only",
+    ECODE_OVER_CAPACITY: "Too many requests; shed by admission control",
     ECODE_CLIENT_INTERNAL: "Client Internal Error",
 }
 
@@ -113,6 +120,8 @@ class EtcdError(Exception):
             return 404
         if self.error_code == ECODE_NO_SPACE:
             return 507  # Insufficient Storage
+        if self.error_code == ECODE_OVER_CAPACITY:
+            return 429  # Too Many Requests
         if self.error_code in (ECODE_NOT_FILE, ECODE_DIR_NOT_EMPTY):
             return 403
         if self.error_code in (ECODE_TEST_FAILED, ECODE_NODE_EXIST):
@@ -133,3 +142,16 @@ class EtcdNoSpace(EtcdError):
 
     def __init__(self, cause: str = "", index: int = 0):
         super().__init__(ECODE_NO_SPACE, cause, index)
+
+
+class EtcdOverCapacity(EtcdError):
+    """Typed admission-control rejection (PR 12): the front door shed
+    this request — tenant token bucket / inflight quota or a global
+    ceiling exhausted.  ``retry_after`` is the server's pacing hint in
+    seconds; the HTTP layer surfaces it as a ``Retry-After`` header on
+    the 429 so shedding is an *answer*, never a timeout."""
+
+    def __init__(self, cause: str = "", index: int = 0,
+                 retry_after: float = 1.0):
+        super().__init__(ECODE_OVER_CAPACITY, cause, index)
+        self.retry_after = max(0.0, float(retry_after))

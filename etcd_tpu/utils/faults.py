@@ -104,6 +104,15 @@ FAULT_CATALOG: dict[str, str] = {
         "partition"),
     "http.client": "client API handler entry (v2 surface)",
     "http.peer": "peer HTTP handler entry (/mraft surface)",
+    "frontdoor.accept": (
+        "event-driven front door accept path (PR 12): drop/err => "
+        "the accepted socket is closed before any byte, delay "
+        "stalls the accept (a slow front end)"),
+    "frontdoor.read": (
+        "front-door per-connection read-ready path: drop => the "
+        "connection is torn down mid-request, err => typed 503, "
+        "delay stalls the event loop (global slowdown — overload "
+        "composition drills use this)"),
 }
 
 _ACTIONS = ("err", "enospc", "delay", "drop", "corrupt")

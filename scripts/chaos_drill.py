@@ -638,17 +638,27 @@ def linz_drill(cycles: int) -> None:
 # and therefore the same deterministic (once-qualified) injections.
 
 NEMESIS_KINDS = ("one_way_partition", "link_delay", "fsync_eio",
-                 "nospace", "leader_kill")
+                 "nospace", "leader_kill", "overload")
+
+
+def _delay_params(rng, dur_lo=6.0):
+    src = rng.randrange(3)
+    return {"src": src, "dst": (src + 1 + rng.randrange(2)) % 3,
+            "dur": dur_lo + rng.randrange(4),
+            "ms": 20 + rng.randrange(40),
+            "p": round(0.3 + 0.4 * rng.random(), 2)}
 
 
 def plan_nemesis(seed: int, cycles: int, smoke: bool) -> list[list]:
-    """Deterministic schedule: cycle c runs kinds[2c..2c+1] (mod 5),
-    so >= 3 cycles cover every kind; all parameters (victims,
-    directions, durations, delay probabilities) come from the seeded
-    RNG.  Returns a list of cycles, each a list of op dicts."""
+    """Deterministic schedule: cycle c runs kinds[2c..2c+1] (mod
+    len(kinds)), so >= 3 cycles cover every kind; all parameters
+    (victims, directions, durations, delay probabilities, overload
+    sub-faults) come from the seeded RNG.  Returns a list of cycles,
+    each a list of op dicts."""
     rng = random.Random(seed)
     if smoke:
-        # one short cycle: delay window + NOSPACE episode + EIO
+        # one short cycle: delay window + NOSPACE episode + an
+        # overload burst composed with link delay (PR 12) + EIO
         # fail-stop (the partition/kill arms live in --check runs)
         src = rng.randrange(3)
         return [[
@@ -657,25 +667,35 @@ def plan_nemesis(seed: int, cycles: int, smoke: bool) -> list[list]:
              "dur": 6.0, "ms": 20 + rng.randrange(20),
              "p": 0.5},
             {"kind": "nospace", "dur": 3.0},
+            {"kind": "overload",
+             "subop": dict(_delay_params(rng, dur_lo=4.0),
+                           kind="link_delay")},
             {"kind": "fsync_eio"},
         ]]
     plan = []
     for c in range(cycles):
         ops = []
-        for k in (NEMESIS_KINDS[(2 * c) % 5],
-                  NEMESIS_KINDS[(2 * c + 1) % 5]):
+        for k in (NEMESIS_KINDS[(2 * c) % len(NEMESIS_KINDS)],
+                  NEMESIS_KINDS[(2 * c + 1) % len(NEMESIS_KINDS)]):
             op = {"kind": k}
             if k == "one_way_partition":
                 op["victim"] = rng.randrange(3)
                 op["dur"] = 8.0 + rng.randrange(5)
             elif k == "link_delay":
-                op["src"] = rng.randrange(3)
-                op["dst"] = (op["src"] + 1 + rng.randrange(2)) % 3
-                op["dur"] = 6.0 + rng.randrange(4)
-                op["ms"] = 20 + rng.randrange(40)
-                op["p"] = round(0.3 + 0.4 * rng.random(), 2)
+                op.update(_delay_params(rng))
             elif k == "nospace":
                 op["dur"] = 3.0 + rng.randrange(3)
+            elif k == "overload":
+                # the PR-12 gate: an abusive-tenant burst is shed by
+                # the front door WHILE a gray failure runs underneath
+                sub = rng.choice(("leader_kill", "nospace",
+                                  "link_delay"))
+                subop = {"kind": sub}
+                if sub == "link_delay":
+                    subop.update(_delay_params(rng))
+                elif sub == "nospace":
+                    subop["dur"] = 3.0 + rng.randrange(3)
+                op["subop"] = subop
             ops.append(op)
         plan.append(ops)
     return plan
@@ -721,6 +741,15 @@ def nemesis_drill(cycles: int, smoke: bool, check: bool) -> None:
 
     flight_dir = os.path.join(BASE, "flight")
     env["ETCD_FLIGHT_DIR"] = flight_dir
+    # PR 12: the overload op's abusive tenant gets a tiny bucket via
+    # the front door's env override (rate=10/s, burst=5, 64
+    # inflight, 1000 watches) so its burst is SHED while the steady
+    # nemesis tenants keep the generous defaults — the drill proves
+    # overload isolation composes with gray failures, not that
+    # everything degrades together.  The rate must sit well below
+    # what 6 blocking writers achieve through the raft path (~50/s)
+    # or the burst self-paces under the bucket and nothing sheds.
+    env["ETCD_FRONTDOOR_TENANTS"] = "nmburst=10,5,64,1000"
     shutil.rmtree(BASE, ignore_errors=True)
     os.makedirs(flight_dir, exist_ok=True)
     procs = {i: start(i) for i in range(3)}
@@ -735,6 +764,7 @@ def nemesis_drill(cycles: int, smoke: bool, check: bool) -> None:
     issued: dict[str, set] = {}
     eio_results = []      # (victim, returncode, dump_ok)
     nospace_results = []  # (rejected_405, read_ok, recovered)
+    overload_results = []  # (sub_kind, sheds, typed_bad, ok)
 
     def client_loop(t):
         # writer-reader pair per key: a linearizable default GET may
@@ -926,11 +956,88 @@ def nemesis_drill(cycles: int, smoke: bool, check: bool) -> None:
         alive[v] = True
         wait_writable(45, who="post-kill cluster")
 
+    def op_overload(op):
+        # PR 12: an abusive tenant (tiny env-override bucket) bursts
+        # writes WHILE a gray failure runs underneath.  The front
+        # door must shed the burst as fast typed 429s, the steady
+        # clients keep their zero-stale/zero-lost invariants, and
+        # the sub-fault's own gates still hold.
+        sub = op["subop"]
+        print(f"  nemesis: overload burst (tenant nmburst) "
+              f"composed with {sub['kind']}", flush=True)
+        burst = {"sheds": 0, "typed_bad": 0, "ok": 0,
+                 "conn_fail": 0}
+        burst_lock = threading.Lock()
+        burst_stop = threading.Event()
+
+        def burst_loop(b):
+            i = 0
+            while not burst_stop.is_set():
+                i += 1
+                targets = [s for s in range(3) if alive[s]]
+                if not targets:
+                    time.sleep(0.3)
+                    continue
+                key = f"/burst/b{b}"
+                val = f"x{i}"
+                issued.setdefault(key, set()).add(val)
+                req = urllib.request.Request(
+                    f"{CLIENT[rng.choice(targets)]}/v2/keys{key}",
+                    data=f"value={val}".encode(), method="PUT",
+                    headers={"Content-Type":
+                             "application/x-www-form-urlencoded",
+                             "X-Etcd-Tenant": "nmburst"})
+                try:
+                    with urllib.request.urlopen(req, timeout=5) as r:
+                        r.read()
+                    with burst_lock:
+                        burst["ok"] += 1
+                except urllib.error.HTTPError as e:
+                    body = e.read() or b"{}"
+                    if e.code == 429:
+                        try:
+                            typed = (json.loads(body).get(
+                                "errorCode") == 406
+                                and e.headers.get("Retry-After")
+                                is not None)
+                        except ValueError:
+                            typed = False
+                        with burst_lock:
+                            burst["sheds"] += 1
+                            if not typed:
+                                burst["typed_bad"] += 1
+                    # other codes (405 during NOSPACE) are the
+                    # sub-fault speaking, not the front door
+                except Exception:
+                    with burst_lock:
+                        burst["conn_fail"] += 1
+
+        bts = [threading.Thread(target=burst_loop, args=(b,),
+                                daemon=True) for b in range(6)]
+        for t in bts:
+            t.start()
+        time.sleep(1.5)  # sheds must appear under steady state too
+        try:
+            OPS[sub["kind"]](sub)
+            time.sleep(1.0)
+        finally:
+            burst_stop.set()
+            for t in bts:
+                t.join(10)
+        overload_results.append((sub["kind"], burst["sheds"],
+                                 burst["typed_bad"], burst["ok"]))
+        print(f"  nemesis: overload burst over {sub['kind']}: "
+              f"{burst['sheds']} typed sheds "
+              f"({burst['typed_bad']} malformed), {burst['ok']} "
+              f"admitted, {burst['conn_fail']} conn failures",
+              flush=True)
+
     OPS = {"one_way_partition": op_one_way_partition,
            "link_delay": op_link_delay,
            "fsync_eio": op_fsync_eio,
            "nospace": op_nospace,
-           "leader_kill": op_leader_kill}
+           "leader_kill": op_leader_kill,
+           "overload": op_overload}
 
     try:
         time.sleep(22)
@@ -995,8 +1102,12 @@ def nemesis_drill(cycles: int, smoke: bool, check: bool) -> None:
         if check:
             n_eio = sum(1 for ops in plan for op in ops
                         if op["kind"] == "fsync_eio")
+            # an overload op's nospace SUB-fault runs the same episode
+            # gate and appends to nospace_results too
             n_nospace = sum(1 for ops in plan for op in ops
-                            if op["kind"] == "nospace")
+                            if op["kind"] == "nospace"
+                            or (op["kind"] == "overload"
+                                and op["subop"]["kind"] == "nospace"))
             assert len(eio_results) == n_eio
             for v, rc, dump_ok in eio_results:
                 assert rc == FAIL_STOP_EXIT, \
@@ -1010,6 +1121,18 @@ def nemesis_drill(cycles: int, smoke: bool, check: bool) -> None:
                 assert rejected, "no write saw the 405 NOSPACE code"
                 assert read_ok, "reads did not serve during NOSPACE"
                 assert recovered, "NOSPACE episode did not recover"
+            # PR 12: every overload op shed the abusive tenant, and
+            # every shed was a typed 429 (+ Retry-After) — never a
+            # timeout or an untyped body
+            n_over = sum(1 for ops in plan for op in ops
+                         if op["kind"] == "overload")
+            assert len(overload_results) == n_over
+            for sub, sheds, typed_bad, _ok in overload_results:
+                assert sheds >= 1, \
+                    f"overload({sub}): burst was never shed"
+                assert typed_bad == 0, \
+                    (f"overload({sub}): {typed_bad} sheds missing "
+                     f"the typed 429 vocabulary")
             assert stats["acked"] > 0 and stats["reads_ok"] > 0
             # replay determinism, stated precisely: the plan is a
             # pure function of the seed (re-derived + compared at
@@ -1030,7 +1153,10 @@ def nemesis_drill(cycles: int, smoke: bool, check: bool) -> None:
               f"stale reads, ZERO lost acked writes, "
               f"{len(eio_results)} fail-stop exit(s), "
               f"{len(nospace_results)} NOSPACE episode(s) "
-              f"recovered", flush=True)
+              f"recovered, "
+              f"{sum(r[1] for r in overload_results)} overload "
+              f"shed(s) across {len(overload_results)} burst(s)",
+              flush=True)
     except (AssertionError, RuntimeError):
         stop.set()
         print(f"NEMESIS GATE FAILURE — replay with: python "

@@ -1,0 +1,702 @@
+#!/usr/bin/env python
+"""Client-swarm bench for the front door (PR 12, ROADMAP item 4):
+>= 50k concurrent client streams held by ONE event-driven front door
+in bounded memory, a zipf tenant mix of watch + read + write traffic,
+and the overload gates — no tenant starved below its quota share,
+p99 under the ceiling, and every shed request a fast typed 429
+(never a timeout or a silent drop).
+
+Scale accounting: a "stream" is one registered watch multiplexed
+onto a TCP connection (the thread-per-stream migration IS the
+tentpole).  The container's RLIMIT_NOFILE hard cap bounds raw
+sockets per process (client + server share this process, 2 fds per
+conn), so the bench auto-sizes: conns = as many real sockets as the
+fd budget allows, streams/conn = enough multiplexed watches to hold
+>= --target-streams live streams.  Both numbers are gated and
+reported; thread count is gated too (the swarm must NOT cost a
+thread per stream).
+
+Run:
+    python scripts/swarm_bench.py --check     # full scale + gates
+    python scripts/swarm_bench.py --smoke     # tier-1 wiring (fast)
+
+Legs:
+  1. ceiling probe  — a tiny front door at max_conns=N accepts N and
+     CLOSES the overflow at accept (billed conn_ceiling, no 429).
+  2. swarm hold     — open the conn swarm, register the multiplexed
+     watch streams (zipf tenant mix), gate RSS/stream + threads.
+  3. traffic        — modest tenants paced under quota + one abusive
+     tenant over its override; gates: modest success >= 99.5%, modest
+     p99 <= ceiling, abuser IS shed, sheds are typed 429 +
+     Retry-After with p99 <= shed ceiling, zero client socket errors.
+  4. broadcast      — PUT to sampled watched keys; the events must
+     arrive on the sampled streams (the held conns are alive, not
+     just open).
+"""
+
+from __future__ import annotations
+
+import argparse
+import errno
+import json
+import os
+import resource
+import selectors
+import socket
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+from etcd_tpu.raft import Peer, STATE_LEADER, start_node  # noqa: E402
+from etcd_tpu.server import (  # noqa: E402
+    ClusterStore,
+    EtcdServer,
+    Member,
+    gen_id,
+)
+from etcd_tpu.server.frontdoor import (  # noqa: E402
+    FrontDoor,
+    FrontDoorConfig,
+)
+from etcd_tpu.store import Store  # noqa: E402
+from etcd_tpu.wire.requests import Request  # noqa: E402
+
+_ART_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "bench_artifacts")
+
+FD_SLACK = 512          # listener, wakeups, drivers, stdio, jitter
+
+
+def rss_kib() -> int:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1])
+    return 0
+
+
+def raise_nofile() -> int:
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < hard:
+        resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+    return hard
+
+
+def zipf_weights(n: int, s: float = 1.2) -> list:
+    w = [1.0 / (i + 1) ** s for i in range(n)]
+    tot = sum(w)
+    return [x / tot for x in w]
+
+
+def zipf_plan(conns: int, tenants: int) -> list:
+    """Deterministic zipf assignment of conns to tenant names."""
+    w = zipf_weights(tenants)
+    counts = [max(1, int(round(x * conns))) for x in w]
+    while sum(counts) > conns:
+        counts[counts.index(max(counts))] -= 1
+    while sum(counts) < conns:
+        counts[0] += 1
+    plan = []
+    for t, c in enumerate(counts):
+        plan += [f"swarm{t:02d}"] * c
+    return plan[:conns]
+
+
+# -- in-process single-node server (test_server.make_cluster shape) --
+
+
+class _NullStorage:
+    def save(self, st, ents):
+        pass
+
+    def save_snap(self, snap):
+        pass
+
+    def cut(self):
+        pass
+
+    def close(self):
+        pass
+
+
+def start_server() -> EtcdServer:
+    peers = [Peer(id=1, context=json.dumps(
+        Member(id=1, name="node1").to_dict()).encode())]
+    st = Store()
+    node = start_node(1, peers, 10, 1)
+    s = EtcdServer(
+        store=st, node=node, id=1,
+        attributes={"Name": "node1", "ClientURLs": []},
+        storage=_NullStorage(), send=lambda msgs: None,
+        cluster_store=ClusterStore(st), snap_count=1_000_000,
+        tick_interval=0.01, sync_interval=0.05)
+    s._start()
+    deadline = time.monotonic() + 10
+    while s.node.r.state != STATE_LEADER:
+        if time.monotonic() > deadline:
+            raise RuntimeError("no leader")
+        time.sleep(0.01)
+    return s
+
+
+# -- raw-socket swarm client ------------------------------------------------
+
+
+class SwarmConn:
+    __slots__ = ("sock", "fd", "tenant", "cid", "state", "buf",
+                 "out", "events", "error", "tail")
+
+    def __init__(self, sock, tenant, cid):
+        self.sock = sock
+        self.fd = sock.fileno()
+        self.tenant = tenant
+        self.cid = cid
+        self.state = "connecting"
+        self.buf = b""
+        self.out = b""
+        self.events = 0
+        self.error = None
+        self.tail = b""
+
+
+class WatchSwarm:
+    """Selectors-based swarm: every conn posts one multiplexed
+    /v2/watch batch of ``streams`` stream-watches, then holds the
+    chunked response open while a drain thread counts event lines."""
+
+    def __init__(self, addr, streams: int):
+        self.addr = addr
+        self.streams = streams
+        self.sel = selectors.DefaultSelector()
+        self.conns = {}
+        self.open_ok = 0
+        self.failed = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+
+    def _request(self, c: SwarmConn) -> bytes:
+        specs = [{"key": f"/swarm/{c.tenant}/c{c.cid}/s{i}",
+                  "stream": True} for i in range(self.streams)]
+        body = json.dumps(specs).encode()
+        return (b"POST /v2/watch HTTP/1.1\r\n"
+                b"Host: swarm\r\n"
+                b"X-Etcd-Tenant: " + c.tenant.encode() + b"\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: " + str(len(body)).encode()
+                + b"\r\n\r\n" + body)
+
+    def launch(self, plan, batch=512, timeout=120.0):
+        """Open one conn per plan entry (tenant names), batched so
+        the SYN burst stays under the front door's backlog."""
+        self._thread = threading.Thread(target=self._loop,
+                                        daemon=True)
+        self._thread.start()
+        deadline = time.monotonic() + timeout
+        for lo in range(0, len(plan), batch):
+            chunk = plan[lo:lo + batch]
+            with self._lock:
+                for i, tenant in enumerate(chunk):
+                    sock = socket.socket()
+                    sock.setblocking(False)
+                    rc = sock.connect_ex(self.addr)
+                    if rc not in (0, errno.EINPROGRESS,
+                                  errno.EWOULDBLOCK):
+                        sock.close()
+                        self.failed += 1
+                        continue
+                    c = SwarmConn(sock, tenant, lo + i)
+                    c.out = self._request(c)
+                    self.conns[c.fd] = c
+                    self.sel.register(
+                        sock,
+                        selectors.EVENT_READ | selectors.EVENT_WRITE,
+                        c)
+            # wait for this chunk to finish its handshake before the
+            # next SYN burst
+            want = min(lo + batch, len(plan))
+            while self.open_ok + self.failed < want:
+                if time.monotonic() > deadline:
+                    return
+                time.sleep(0.01)
+
+    def _loop(self):
+        while not self._stop.is_set():
+            with self._lock:
+                events = self.sel.select(0.05)
+                for key, mask in events:
+                    self._service(key.data, mask)
+
+    def _service(self, c: SwarmConn, mask: int):
+        if c.state == "closed":
+            return
+        if mask & selectors.EVENT_WRITE:
+            if c.state == "connecting":
+                err = c.sock.getsockopt(socket.SOL_SOCKET,
+                                        socket.SO_ERROR)
+                if err:
+                    self._fail(c, os.strerror(err))
+                    return
+                c.state = "sending"
+            if c.out:
+                try:
+                    n = c.sock.send(c.out)
+                    c.out = c.out[n:]
+                except (BlockingIOError, InterruptedError):
+                    pass
+                except OSError as e:
+                    self._fail(c, str(e))
+                    return
+            if not c.out and c.state == "sending":
+                c.state = "headers"
+                self.sel.modify(c.sock, selectors.EVENT_READ, c)
+        if mask & selectors.EVENT_READ:
+            try:
+                data = c.sock.recv(65536)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError as e:
+                self._fail(c, str(e))
+                return
+            if not data:
+                self._fail(c, "peer closed")
+                return
+            if c.state == "headers":
+                c.buf += data
+                end = c.buf.find(b"\r\n\r\n")
+                if end < 0:
+                    if len(c.buf) > 65536:
+                        self._fail(c, "oversized headers")
+                    return
+                status = c.buf.split(b"\r\n", 1)[0]
+                if b" 200 " not in status + b" ":
+                    self._fail(c, status.decode("latin-1",
+                                                "replace"))
+                    return
+                c.state = "open"
+                self.open_ok += 1
+                data = c.buf[end + 4:]
+                c.buf = b""
+            # open: count event lines, keep only a token-split tail
+            scan = c.tail + data
+            c.events += scan.count(b'"action"')
+            c.tail = scan[-16:]
+
+    def _fail(self, c: SwarmConn, why: str):
+        c.error = why
+        c.state = "closed"
+        self.failed += 1
+        try:
+            self.sel.unregister(c.sock)
+        except (KeyError, ValueError):
+            pass
+        c.sock.close()
+
+    def errors(self, limit=5):
+        return [(c.tenant, c.error) for c in self.conns.values()
+                if c.error][:limit]
+
+    def close(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        for c in self.conns.values():
+            if c.state != "closed":
+                try:
+                    self.sel.unregister(c.sock)
+                except (KeyError, ValueError):
+                    pass
+                c.sock.close()
+        self.sel.close()
+
+
+# -- keepalive HTTP driver (blocking, one socket, many requests) -----------
+
+
+class Driver:
+    def __init__(self, addr, tenant):
+        self.addr = addr
+        self.tenant = tenant
+        self.sock = None
+        self.lat_ok = []
+        self.lat_shed = []
+        self.codes = {}
+        self.sock_errors = 0
+        self.shed_bodies_ok = 0
+        self.shed_bodies_bad = 0
+
+    def _connect(self):
+        self.sock = socket.create_connection(self.addr, timeout=10)
+        self.sock.setsockopt(socket.IPPROTO_TCP,
+                             socket.TCP_NODELAY, 1)
+
+    def _roundtrip(self, raw: bytes):
+        if self.sock is None:
+            self._connect()
+        t0 = time.perf_counter()
+        self.sock.sendall(raw)
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            d = self.sock.recv(65536)
+            if not d:
+                raise OSError("peer closed")
+            buf += d
+        head, body = buf.split(b"\r\n\r\n", 1)
+        headers = head.split(b"\r\n")
+        code = int(headers[0].split()[1])
+        clen = 0
+        hmap = {}
+        for h in headers[1:]:
+            k, _, v = h.partition(b":")
+            hmap[k.strip().lower()] = v.strip()
+        clen = int(hmap.get(b"content-length", b"0"))
+        while len(body) < clen:
+            d = self.sock.recv(65536)
+            if not d:
+                raise OSError("peer closed")
+            body += d
+        return code, hmap, body, time.perf_counter() - t0
+
+    def run(self, duration: float, rate: float | None,
+            write_every: int = 4):
+        """GET-heavy loop with a write every ``write_every`` ops;
+        rate=None means unpaced (the abuser)."""
+        stop_at = time.monotonic() + duration
+        i = 0
+        t0 = time.monotonic()
+        while time.monotonic() < stop_at:
+            path = f"/v2/keys/{self.tenant}/k{i % 8}"
+            if i % write_every == 0:
+                body = b"value=x"
+                raw = (f"PUT {path} HTTP/1.1\r\nHost: d\r\n"
+                       f"X-Etcd-Tenant: {self.tenant}\r\n"
+                       "Content-Type: application/"
+                       "x-www-form-urlencoded\r\n"
+                       f"Content-Length: {len(body)}\r\n\r\n"
+                       ).encode() + body
+            else:
+                raw = (f"GET {path} HTTP/1.1\r\nHost: d\r\n"
+                       f"X-Etcd-Tenant: {self.tenant}\r\n\r\n"
+                       ).encode()
+            try:
+                code, hmap, body, lat = self._roundtrip(raw)
+            except OSError:
+                self.sock_errors += 1
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+                self.sock = None
+                continue
+            self.codes[code] = self.codes.get(code, 0) + 1
+            if code == 429:
+                self.lat_shed.append(lat)
+                try:
+                    doc = json.loads(body)
+                    ok = (doc.get("errorCode") == 406
+                          and b"retry-after" in hmap)
+                except ValueError:
+                    ok = False
+                if ok:
+                    self.shed_bodies_ok += 1
+                else:
+                    self.shed_bodies_bad += 1
+            else:
+                self.lat_ok.append(lat)
+            i += 1
+            if rate:
+                ahead = i / rate - (time.monotonic() - t0)
+                if ahead > 0:
+                    time.sleep(ahead)
+
+    def close(self):
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+
+def p99(xs):
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(len(xs) * 0.99))]
+
+
+# -- legs -------------------------------------------------------------------
+
+
+def ceiling_probe(server, n=64, extra=16) -> dict:
+    """Overflow conns beyond max_conns are CLOSED at accept —
+    bounded memory is a ceiling, not a 429."""
+    fd = FrontDoor(server, "127.0.0.1", 0,
+                   config=FrontDoorConfig(max_conns=n)).start()
+    socks = []
+    try:
+        for _ in range(n + extra):
+            s = socket.create_connection(fd.server_address,
+                                         timeout=5)
+            socks.append(s)
+        closed = 0
+        deadline = time.monotonic() + 10
+        while closed < extra and time.monotonic() < deadline:
+            closed = 0
+            for s in socks:
+                s.setblocking(False)
+                try:
+                    if s.recv(1) == b"":
+                        closed += 1
+                except (BlockingIOError, InterruptedError):
+                    pass
+                except OSError:
+                    closed += 1
+            time.sleep(0.05)
+        st = fd.admission.stats()["admission"]
+        return {"max_conns": n, "opened": len(socks),
+                "closed_by_ceiling": closed,
+                "billed_close": st.get("close/conn_ceiling", 0),
+                "conns_open": len(fd._conns)}
+    finally:
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        fd.shutdown()
+
+
+def run_swarm(args) -> dict:
+    hard = raise_nofile()
+    budget = max(256, (hard - FD_SLACK) // 2)
+    conns = min(args.conns or budget, budget)
+    streams = max(1, -(-args.target_streams // conns))
+    streams = min(streams, 64)
+    out = {"fd_hard_limit": hard, "conns_target": conns,
+           "streams_per_conn": streams,
+           "streams_target": args.target_streams}
+
+    server = start_server()
+    cfg = FrontDoorConfig(
+        max_conns=conns + 256,
+        tenant_rate=2000.0, tenant_burst=2000.0,
+        tenant_inflight=512,
+        tenant_watches=args.target_streams * 2,
+        tenant_overrides={"abuser": (args.abuser_rate,
+                                     args.abuser_rate, 64,
+                                     1024)},
+    )
+    fd = FrontDoor(server, "127.0.0.1", 0, watch_timeout=3600.0,
+                   watch_keepalive=30.0, config=cfg).start()
+    addr = fd.server_address
+    swarm = WatchSwarm(addr, streams)
+    try:
+        out["ceiling"] = ceiling_probe(server)
+
+        # -- leg 2: open + hold ------------------------------------
+        rss0 = rss_kib()
+        thr0 = threading.active_count()
+        plan = zipf_plan(conns, args.tenants)
+        t0 = time.perf_counter()
+        swarm.launch(plan, batch=args.batch,
+                     timeout=args.open_timeout)
+        open_s = time.perf_counter() - t0
+        st = fd.admission.stats()
+        live_streams = sum(t["watches"]
+                           for t in st["tenants"].values())
+        rss1 = rss_kib()
+        out["swarm"] = {
+            "conns_open_client": swarm.open_ok,
+            "conns_open_server": len(fd._conns),
+            "conns_failed": swarm.failed,
+            "open_errors": swarm.errors(),
+            "open_s": round(open_s, 2),
+            "conns_per_s": round(swarm.open_ok / max(open_s, 1e-9)),
+            "live_streams": live_streams,
+            "rss_before_kib": rss0,
+            "rss_after_kib": rss1,
+            "rss_per_stream_kib": round(
+                (rss1 - rss0) / max(live_streams, 1), 2),
+            "threads": threading.active_count(),
+            "threads_before": thr0,
+        }
+
+        # -- leg 3: traffic under the held swarm -------------------
+        # seed the driver key space so GETs measure serving, not 404s
+        for name in ([f"modest{i}"
+                      for i in range(args.modest_tenants)]
+                     + ["abuser"]):
+            for j in range(8):
+                server.do(Request(id=gen_id(), method="PUT",
+                                  path=f"/{name}/k{j}", val="seed"),
+                          timeout=10)
+        modest = [Driver(addr, f"modest{i}")
+                  for i in range(args.modest_tenants)]
+        abuser = Driver(addr, "abuser")
+        threads = [threading.Thread(
+            target=d.run, args=(args.duration, args.modest_rate),
+            daemon=True) for d in modest]
+        threads.append(threading.Thread(
+            target=abuser.run, args=(args.duration, None),
+            daemon=True))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=args.duration + 30)
+        ok = sum(n for d in modest
+                 for c, n in d.codes.items() if c < 400)
+        att = sum(n for d in modest for n in d.codes.values())
+        out["traffic"] = {
+            "modest_attempts": att,
+            "modest_ok": ok,
+            "modest_success": round(ok / max(att, 1), 4),
+            "modest_p99_s": round(p99(
+                [x for d in modest for x in d.lat_ok]), 4),
+            "modest_sheds": sum(d.codes.get(429, 0)
+                                for d in modest),
+            "modest_sock_errors": sum(d.sock_errors
+                                      for d in modest),
+            "abuser_attempts": sum(abuser.codes.values()),
+            "abuser_ok": sum(n for c, n in abuser.codes.items()
+                             if c < 400),
+            "abuser_sheds": abuser.codes.get(429, 0),
+            "abuser_shed_p99_s": round(p99(abuser.lat_shed), 4),
+            "sheds_typed_ok": abuser.shed_bodies_ok,
+            "sheds_typed_bad": abuser.shed_bodies_bad
+            + sum(d.shed_bodies_bad for d in modest),
+            "abuser_sock_errors": abuser.sock_errors,
+        }
+        for d in modest + [abuser]:
+            d.close()
+
+        # -- leg 4: broadcast into held streams --------------------
+        sample = [c for c in swarm.conns.values()
+                  if c.state == "open"][:args.bcast_sample]
+        for c in sample:
+            server.do(Request(
+                id=gen_id(), method="PUT",
+                path=f"/swarm/{c.tenant}/c{c.cid}/s0",
+                val="bcast"), timeout=10)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if all(c.events >= 1 for c in sample):
+                break
+            time.sleep(0.05)
+        out["broadcast"] = {
+            "sampled": len(sample),
+            "delivered": sum(1 for c in sample if c.events >= 1),
+        }
+        out["frontdoor_admission"] = fd.admission.stats()["admission"]
+    finally:
+        swarm.close()
+        fd.shutdown()
+        server.stop()
+    return out
+
+
+def gates(out: dict, args) -> list:
+    f = []
+    c, s, t, b = (out["ceiling"], out["swarm"], out["traffic"],
+                  out["broadcast"])
+    if c["closed_by_ceiling"] < 1 or c["billed_close"] < 1:
+        f.append("ceiling: overflow conns not closed/billed")
+    if c["conns_open"] > c["max_conns"]:
+        f.append("ceiling: conns_open above max_conns")
+    want_conns = out["conns_target"]
+    if s["conns_open_client"] < want_conns * 0.99:
+        f.append(f"swarm: only {s['conns_open_client']}/"
+                 f"{want_conns} conns opened "
+                 f"(errors: {s['open_errors']})")
+    if s["live_streams"] < args.target_streams:
+        f.append(f"swarm: {s['live_streams']} live streams "
+                 f"< target {args.target_streams}")
+    if s["rss_per_stream_kib"] > args.rss_per_stream_kib:
+        f.append(f"swarm: RSS/stream {s['rss_per_stream_kib']} KiB "
+                 f"> {args.rss_per_stream_kib} KiB")
+    if s["threads"] - s["threads_before"] > 8:
+        f.append(f"swarm: thread count grew by "
+                 f"{s['threads'] - s['threads_before']} — "
+                 f"streams are costing threads")
+    if t["modest_success"] < 0.995:
+        f.append(f"fairness: modest success "
+                 f"{t['modest_success']} < 0.995")
+    if t["modest_p99_s"] > args.p99_ceiling:
+        f.append(f"latency: modest p99 {t['modest_p99_s']}s "
+                 f"> {args.p99_ceiling}s")
+    if t["abuser_sheds"] < 1:
+        f.append("shed: abuser was never shed")
+    if t["sheds_typed_bad"]:
+        f.append(f"shed: {t['sheds_typed_bad']} sheds missing the "
+                 f"typed 429 vocabulary")
+    if t["abuser_shed_p99_s"] > args.shed_p99_ceiling:
+        f.append(f"shed: p99 {t['abuser_shed_p99_s']}s "
+                 f"> {args.shed_p99_ceiling}s — sheds must be "
+                 f"fail-fast, not timeouts")
+    if t["modest_sock_errors"] or t["abuser_sock_errors"]:
+        f.append("silent drops: client sockets saw errors/resets")
+    if b["delivered"] < b["sampled"]:
+        f.append(f"broadcast: {b['delivered']}/{b['sampled']} "
+                 f"sampled streams saw their event")
+    return f
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--conns", type=int, default=0,
+                    help="TCP conns (0 = auto from RLIMIT_NOFILE)")
+    ap.add_argument("--target-streams", type=int, default=50_000)
+    ap.add_argument("--tenants", type=int, default=32,
+                    help="zipf tenant count for the watch swarm")
+    ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument("--duration", type=float, default=6.0)
+    ap.add_argument("--modest-tenants", type=int, default=8)
+    ap.add_argument("--modest-rate", type=float, default=30.0)
+    ap.add_argument("--abuser-rate", type=float, default=100.0,
+                    help="abuser tenant bucket rate (override)")
+    ap.add_argument("--bcast-sample", type=int, default=32)
+    ap.add_argument("--open-timeout", type=float, default=300.0)
+    ap.add_argument("--rss-per-stream-kib", type=float, default=64.0)
+    ap.add_argument("--p99-ceiling", type=float, default=1.0)
+    ap.add_argument("--shed-p99-ceiling", type=float, default=0.5)
+    ap.add_argument("--check", action="store_true")
+    ap.add_argument("--smoke", action="store_true",
+                    help="scaled-down swarm, same gates")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        args.conns = 400
+        args.target_streams = 2_000
+        args.duration = 2.0
+        args.modest_tenants = 4
+        args.bcast_sample = 8
+        # small absolute RSS deltas dominate at smoke scale
+        args.rss_per_stream_kib = 256.0
+
+    out = run_swarm(args)
+    print(json.dumps(out, indent=2))
+
+    if not args.smoke:
+        os.makedirs(_ART_DIR, exist_ok=True)
+        stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        path = os.path.join(_ART_DIR, f"swarm_{stamp}.json")
+        with open(path, "w") as fh:
+            json.dump(out, fh, indent=2)
+        print(f"wrote {path}", file=sys.stderr)
+
+    failures = gates(out, args)
+    if failures:
+        print("SWARM BENCH GATE FAILED:", "; ".join(failures),
+              file=sys.stderr)
+        return 1
+    print(f"swarm_bench ok — {out['swarm']['live_streams']} live "
+          f"streams on {out['swarm']['conns_open_client']} conns, "
+          f"{out['traffic']['abuser_sheds']} typed sheds",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

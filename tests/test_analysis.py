@@ -1266,6 +1266,62 @@ def test_prune_baseline_drops_only_dead_entries(tmp_path):
     assert prune_baseline(bl_path, findings, after) == []
 
 
+# -- bounded-queue fires on seeded violations ---------------------------------
+
+
+_BOUNDEDQ_BAD = """
+    import queue
+    from collections import deque
+
+    class Hub:
+        def __init__(self):
+            self.jobs = queue.Queue()               # no bound
+            self.infinite = queue.Queue(maxsize=0)  # stdlib "infinite"
+            self.simple = queue.SimpleQueue()       # unbounded by design
+            self.items = deque()                    # no maxlen
+"""
+
+_BOUNDEDQ_GOOD = """
+    import queue
+    from collections import deque
+
+    class Hub:
+        def __init__(self, depth):
+            self.jobs = queue.Queue(maxsize=1024)
+            self.window = queue.Queue(depth)    # policy exists in code
+            self.items = deque(maxlen=4096)
+            self.seeded = deque([1, 2], maxlen=8)
+"""
+
+
+def test_boundedq_fires_on_seeded_violations(tmp_path):
+    from etcd_tpu.analysis import BoundedQueueChecker
+
+    root = _fixture_root(tmp_path, "etcd_tpu/server/bad.py",
+                         _BOUNDEDQ_BAD)
+    findings = run_checkers(root, [BoundedQueueChecker()])
+    assert len(findings) == 4
+    assert _rules(findings) == {"unbounded-queue"}
+    assert sorted(f.detail for f in findings) \
+        == ["Queue", "Queue", "SimpleQueue", "deque"]
+
+
+def test_boundedq_quiet_on_bounded_forms(tmp_path):
+    from etcd_tpu.analysis import BoundedQueueChecker
+
+    root = _fixture_root(tmp_path, "etcd_tpu/store/good.py",
+                         _BOUNDEDQ_GOOD)
+    assert run_checkers(root, [BoundedQueueChecker()]) == []
+
+
+def test_boundedq_ignores_off_hot_path_dirs(tmp_path):
+    from etcd_tpu.analysis import BoundedQueueChecker
+
+    root = _fixture_root(tmp_path, "etcd_tpu/utils/bad.py",
+                         _BOUNDEDQ_BAD)
+    assert run_checkers(root, [BoundedQueueChecker()]) == []
+
+
 def test_scripts_lint_changed_smoke():
     """`--changed` restricts to git-diff files + their call-graph
     closure and exits like the full gate (0 on a clean-or-baselined

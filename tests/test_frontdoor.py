@@ -1,0 +1,420 @@
+"""Front-door tests (PR 12): tenant grammar, token-bucket semantics
+under clock jitter, the admission decision table, and the live
+event-driven serving path (typed 429s, watch quota at registration,
+multiplexed watch delivery through the loop)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from etcd_tpu.server.frontdoor import (
+    ADMIT,
+    Admission,
+    FrontDoor,
+    FrontDoorConfig,
+    LISTEN_BACKLOG,
+    SHED_ALL,
+    SHED_WRITE,
+    TokenBucket,
+    parse_tenant,
+)
+from etcd_tpu.utils.errors import ECODE_OVER_CAPACITY
+
+from test_server import make_cluster, stop_cluster, wait_for_leader
+
+
+# -- tenant grammar -----------------------------------------------------------
+
+
+def test_tenant_header_wins():
+    assert parse_tenant({"x-etcd-tenant": "team-a"},
+                        "/v2/keys/team-b/x") == "team-a"
+
+
+def test_tenant_from_path_segment():
+    assert parse_tenant({}, "/v2/keys/team-b/x") == "team-b"
+    assert parse_tenant({}, "/v2/keys/solo") == "solo"
+
+
+def test_tenant_default_fallbacks():
+    assert parse_tenant({}, "/v2/keys/") == "default"
+    assert parse_tenant({}, "") == "default"
+    # invalid names must not mint buckets
+    assert parse_tenant({"x-etcd-tenant": "bad name!"},
+                        "/v2/keys/ok") == "ok"
+    assert parse_tenant({"x-etcd-tenant": "x" * 65}, "") == "default"
+    assert parse_tenant({}, "/v2/keys/sp ace/k") == "default"
+
+
+# -- token bucket -------------------------------------------------------------
+
+
+def test_bucket_basic_take_and_refill():
+    b = TokenBucket(rate=10.0, burst=5.0, now=0.0)
+    assert b.take(5.0, now=0.0)
+    assert not b.take(0.1, now=0.0)       # drained
+    assert b.take(1.0, now=0.1)           # 0.1s * 10/s = 1 token
+    assert b.retry_after(1.0, now=0.1) == pytest.approx(0.1)
+
+
+def test_bucket_failed_take_consumes_nothing():
+    b = TokenBucket(rate=1.0, burst=1.0, now=0.0)
+    assert b.take(1.0, now=0.0)
+    for _ in range(10):
+        assert not b.take(1.0, now=0.5)   # repeated denials are free
+    assert b.take(1.0, now=1.5)
+
+
+def test_bucket_refill_monotone_across_clock_jitter():
+    """A clock stepping backward can pause refill but never mint
+    tokens and never drive the count negative."""
+    b = TokenBucket(rate=100.0, burst=10.0, now=10.0)
+    assert b.take(10.0, now=10.0)
+    before = b.tokens
+    b.take(1.0, now=9.0)                  # backward jump
+    assert b.tokens <= before + 1e-9      # no tokens minted
+    assert b.tokens >= 0.0
+    # forward progress resumes from the jitter low-water mark
+    assert b.take(1.0, now=9.1)           # 0.1s after the step
+    b2 = TokenBucket(rate=1.0, burst=5.0, now=0.0)
+    seq = [0.0, 2.0, 1.0, 1.5, 3.0, 2.5, 4.0]
+    last = b2.tokens
+    for t in seq:
+        b2._refill(t)
+        assert 0.0 <= b2.tokens <= b2.burst
+        last = b2.tokens
+    assert last <= b2.burst
+
+
+# -- admission decision table -------------------------------------------------
+
+
+def _adm(**kw) -> Admission:
+    return Admission(FrontDoorConfig(**kw))
+
+
+def test_admit_then_shed_write_then_shed_all():
+    """Write cost > read cost: a draining bucket sheds writes first
+    (shed_write), then reads (shed_all) — the NOSPACE shape per
+    tenant."""
+    a = _adm(tenant_rate=0.0, tenant_burst=1.0, write_cost=1.0,
+             read_cost=0.2)
+    now = time.monotonic()
+    out, reason, _ = a.decide("t", True, now)   # burst covers 1 write
+    assert (out, reason) == (ADMIT, "ok")
+    out, reason, ra = a.decide("t", True, now)  # 0 tokens < 1.0
+    assert (out, reason) == (SHED_WRITE, "tenant_rate") and ra > 0
+    # reads keep flowing while >= 0.2 tokens remain? bucket is at 0
+    # after the write — refill is rate=0, so reads shed too
+    out, reason, _ = a.decide("t", False, now)
+    assert (out, reason) == (SHED_ALL, "tenant_rate")
+
+
+def test_reads_survive_while_writes_shed():
+    a = _adm(tenant_rate=0.0, tenant_burst=0.5, write_cost=1.0,
+             read_cost=0.2)
+    now = time.monotonic()
+    out, reason, _ = a.decide("t", True, now)
+    assert (out, reason) == (SHED_WRITE, "tenant_rate")
+    out, reason, _ = a.decide("t", False, now)
+    assert (out, reason) == (ADMIT, "ok")       # 0.5 >= 0.2
+
+
+def test_unknown_tenant_gets_default_bucket():
+    a = _adm(tenant_rate=1.0, tenant_burst=2.0)
+    out, _, _ = a.decide("never-seen-before", True)
+    assert out == ADMIT
+    st = a.tenants["never-seen-before"]
+    assert st.bucket.burst == 2.0 and st.bucket.rate == 1.0
+
+
+def test_tenant_override_applies():
+    a = _adm(tenant_rate=1.0, tenant_burst=2.0,
+             tenant_overrides={"vip": (100.0, 200.0, 50, 1000)})
+    a.decide("vip", False)
+    st = a.tenants["vip"]
+    assert st.bucket.rate == 100.0 and st.max_watches == 1000
+
+
+def test_global_inflight_ceiling_sheds_all():
+    a = _adm(max_inflight=2)
+    a.begin("x")
+    a.begin("y")
+    out, reason, _ = a.decide("z", False)
+    assert (out, reason) == (SHED_ALL, "global_inflight")
+    a.finish("x")
+    out, _, _ = a.decide("z", False)
+    assert out == ADMIT
+
+
+def test_tenant_inflight_quota():
+    a = _adm(tenant_inflight=1)
+    a.begin("t")
+    out, reason, _ = a.decide("t", False)
+    assert (out, reason) == (SHED_ALL, "tenant_inflight")
+    out, _, _ = a.decide("other", False)
+    assert out == ADMIT                   # isolation: other tenants fine
+
+
+def test_watch_quota_accounting():
+    a = _adm(tenant_watches=3)
+    assert a.try_add_watches("t", 2)
+    assert not a.try_add_watches("t", 2)  # 2+2 > 3, rejected whole
+    assert a.try_add_watches("t", 1)
+    a.release_watches("t", 3)
+    assert a.try_add_watches("t", 3)
+
+
+def test_admission_counts_mirror():
+    a = _adm(tenant_rate=0.0, tenant_burst=0.0)
+    a.decide("t", True)
+    a.decide("t", False)
+    assert a.counts[(SHED_WRITE, "tenant_rate")] == 1
+    assert a.counts[(SHED_ALL, "tenant_rate")] == 1
+    assert a.stats()["tenants"]["t"]["inflight"] == 0
+
+
+def test_backlog_is_centralized():
+    from etcd_tpu.api.http import _Server
+    from etcd_tpu.server.distserver import _PeerHTTPServer
+
+    assert _Server.request_queue_size == LISTEN_BACKLOG
+    assert _PeerHTTPServer.request_queue_size == LISTEN_BACKLOG
+    assert LISTEN_BACKLOG >= 128
+
+
+# -- live integration ---------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def live():
+    servers = make_cluster(1)
+    s = wait_for_leader(servers)
+    fd = FrontDoor(s, "127.0.0.1", 0, server_timeout=5.0,
+                   watch_timeout=5.0, watch_keepalive=1.0,
+                   config=FrontDoorConfig()).start()
+    yield {"server": s, "fd": fd,
+           "base": f"http://127.0.0.1:{fd.server_address[1]}"}
+    fd.shutdown()
+    stop_cluster(servers)
+
+
+def http(method, url, form=None, headers=None):
+    data = None
+    hdrs = dict(headers or {})
+    if form is not None:
+        data = urllib.parse.urlencode(form).encode()
+        hdrs["Content-Type"] = "application/x-www-form-urlencoded"
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=hdrs)
+    try:
+        resp = urllib.request.urlopen(req, timeout=10)
+        return resp.status, dict(resp.headers), resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read().decode()
+
+
+def test_live_write_read_roundtrip(live):
+    st, h, b = http("PUT", live["base"] + "/v2/keys/fd/a",
+                    {"value": "1"})
+    assert st == 201 and json.loads(b)["node"]["value"] == "1"
+    st, h, b = http("GET", live["base"] + "/v2/keys/fd/a")
+    assert st == 200
+    assert "X-Etcd-Index" in h and "X-Raft-Term" in h
+    st, _, b = http("GET", live["base"] + "/v2/keys/fd/missing")
+    assert st == 404 and json.loads(b)["errorCode"] == 100
+
+
+def test_live_parse_errors_are_typed(live):
+    st, _, b = http("GET",
+                    live["base"] + "/v2/keys/fd/a?prevIndex=nan")
+    assert st == 400 and "errorCode" in json.loads(b)
+
+
+def test_live_429_carries_typed_vocabulary(live):
+    """A shed request is a fast typed answer: HTTP 429, errorCode
+    406, Retry-After header, tenant + reason in the cause."""
+    s = live["server"]
+    fd = FrontDoor(s, "127.0.0.1", 0, server_timeout=5.0,
+                   config=FrontDoorConfig(tenant_rate=0.0,
+                                          tenant_burst=1.0)).start()
+    try:
+        base = f"http://127.0.0.1:{fd.server_address[1]}"
+        hdr = {"X-Etcd-Tenant": "abuser"}
+        st, _, _ = http("PUT", base + "/v2/keys/shed",
+                        {"value": "x"}, headers=hdr)
+        assert st == 201                 # burst covers the first
+        st, h, b = http("PUT", base + "/v2/keys/shed",
+                        {"value": "y"}, headers=hdr)
+        assert st == 429
+        assert int(h["Retry-After"]) >= 1
+        doc = json.loads(b)
+        assert doc["errorCode"] == ECODE_OVER_CAPACITY
+        assert "abuser" in doc["cause"]
+        assert "tenant_rate" in doc["cause"]
+        # the other tenant is untouched (isolation)
+        st, _, _ = http("PUT", base + "/v2/keys/ok", {"value": "z"},
+                        headers={"X-Etcd-Tenant": "neighbor"})
+        assert st == 201
+    finally:
+        fd.shutdown()
+
+
+def test_live_watch_quota_rejected_at_register(live):
+    """A quota-exceeding watch batch is a 429 BEFORE the stream
+    opens — never a mid-stream eviction."""
+    s = live["server"]
+    fd = FrontDoor(s, "127.0.0.1", 0, server_timeout=5.0,
+                   config=FrontDoorConfig(tenant_watches=2)).start()
+    try:
+        base = f"http://127.0.0.1:{fd.server_address[1]}"
+        specs = [{"key": f"/q/{i}", "stream": True}
+                 for i in range(3)]
+        req = urllib.request.Request(
+            base + "/v2/watch", data=json.dumps(specs).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json",
+                     "X-Etcd-Tenant": "watcher"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 429
+        doc = json.loads(ei.value.read().decode())
+        assert doc["errorCode"] == ECODE_OVER_CAPACITY
+        assert "watch quota" in doc["cause"]
+        # a batch within quota registers fine, and the quota is
+        # released at stream teardown
+        req = urllib.request.Request(
+            base + "/v2/watch",
+            data=json.dumps(specs[:2]).encode(), method="POST",
+            headers={"Content-Type": "application/json",
+                     "X-Etcd-Tenant": "watcher"})
+        resp = urllib.request.urlopen(req, timeout=10)
+        assert resp.status == 200
+        resp.close()
+    finally:
+        fd.shutdown()
+
+
+def test_live_single_watch_delivers(live):
+    out = {}
+
+    def watcher():
+        out["res"] = http("GET",
+                          live["base"] + "/v2/keys/fd/w?wait=true")
+
+    t = threading.Thread(target=watcher)
+    t.start()
+    time.sleep(0.4)
+    http("PUT", live["base"] + "/v2/keys/fd/w", {"value": "ev"})
+    t.join(8)
+    st, h, b = out["res"]
+    assert st == 200
+    assert json.loads(b)["node"]["value"] == "ev"
+
+
+def test_live_batch_watch_multiplexes(live):
+    out = {}
+
+    def watcher():
+        req = urllib.request.Request(
+            live["base"] + "/v2/watch",
+            data=json.dumps([{"key": "/fd/m1", "stream": False},
+                             {"key": "/fd/m2", "stream": False}]
+                            ).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"})
+        resp = urllib.request.urlopen(req, timeout=10)
+        out["lines"] = [json.loads(ln) for ln in resp if ln.strip()]
+
+    t = threading.Thread(target=watcher)
+    t.start()
+    time.sleep(0.4)
+    http("PUT", live["base"] + "/v2/keys/fd/m2", {"value": "b"})
+    http("PUT", live["base"] + "/v2/keys/fd/m1", {"value": "a"})
+    t.join(8)
+    lines = out["lines"]
+    events = {ln["watch"]: ln for ln in lines if "node" in ln}
+    assert events[0]["node"]["value"] == "a"
+    assert events[1]["node"]["value"] == "b"
+    closed = [ln for ln in lines if ln.get("closed")]
+    assert len(closed) == 2              # both one-shots completed
+
+
+def test_live_frontdoor_stats_endpoint(live):
+    st, _, b = http("GET", live["base"] + "/v2/stats/frontdoor")
+    assert st == 200
+    doc = json.loads(b)
+    assert "admission" in doc and "connsOpen" in doc
+
+
+def test_live_metrics_families_exported(live):
+    st, _, b = http("GET", live["base"] + "/metrics")
+    assert st == 200
+    assert "etcd_conns_open" in b
+    assert "etcd_admission_total" in b
+
+
+def test_client_honors_retry_after_same_endpoint():
+    """api/client.py satellite: a 429 with Retry-After retries the
+    SAME endpoint after the pacing hint instead of failing over —
+    and without retries budget it stays fail-fast."""
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from etcd_tpu.api import Client, ClientError
+
+    hits = {"good": 0, "bad": 0}
+
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            hits["good"] += 1
+            if hits["good"] == 1:
+                body = b'{"errorCode": 406, "message": "shed"}'
+                self.send_response(429)
+                self.send_header("Retry-After", "1")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            body = (b'{"action": "get", "node": '
+                    b'{"key": "/k", "value": "v"}}')
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = HTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        ep = f"http://127.0.0.1:{httpd.server_address[1]}"
+        # decoy endpoint that must NOT be tried: failing over a shed
+        # request defeats the shed
+        c = Client([ep, "http://127.0.0.1:1"], retries=2,
+                   timeout=5.0)
+        t0 = time.monotonic()
+        out = c.get("/k")
+        assert out["node"]["value"] == "v"
+        assert time.monotonic() - t0 >= 1.0     # paced by Retry-After
+        assert hits["good"] == 2                # same endpoint, twice
+        # fail-fast preserved when no retry budget exists
+        c0 = Client([ep], retries=0, timeout=5.0)
+        hits["good"] = 0
+        with pytest.raises(ClientError) as ei:
+            c0.get("/k")
+        assert ei.value.code == 429
+    finally:
+        httpd.shutdown()
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))
