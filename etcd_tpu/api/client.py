@@ -104,8 +104,12 @@ class Client:
                                 hint = float(retry_after)
                             except ValueError:
                                 hint = 0.0
-                            time.sleep(max(hint,
-                                           admission_backoff.next()))
+                            # clamp the server's hint: a buggy or
+                            # hostile Retry-After must not park the
+                            # caller beyond the backoff cap
+                            time.sleep(min(
+                                max(hint, admission_backoff.next()),
+                                30.0))
                             continue  # same endpoint, paced
                         raise ClientError(e.code, parsed) from None
                     except (urllib.error.URLError, OSError) as e:

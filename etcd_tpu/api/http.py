@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import queue
 import socketserver
 import threading
@@ -346,7 +347,9 @@ class EtcdRequestHandler(BaseHTTPRequestHandler):
             if "keepalive" in form:
                 try:
                     keepalive = float(form["keepalive"][0])
-                    if keepalive < 0:
+                    # reject non-finite values (NaN compares False
+                    # against every bound yet is truthy)
+                    if keepalive < 0 or not math.isfinite(keepalive):
                         raise ValueError
                 except ValueError:
                     raise EtcdError(ECODE_INVALID_FIELD,

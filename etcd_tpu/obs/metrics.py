@@ -293,9 +293,10 @@ _DEFS = (
         "etcd_admission_total", "counter",
         "Front-door admission decisions (server/frontdoor.py), by "
         "outcome (admit | shed_write | shed_all | close) and reason "
-        "(ok | tenant_rate | tenant_inflight | global_inflight | "
-        "queue_depth | conn_ceiling).  Every client request and "
-        "accepted connection crosses exactly one decision.",
+        "(ok | tenant_rate | tenant_inflight | tenant_watches | "
+        "global_inflight | queue_depth | conn_ceiling).  Every "
+        "client request and accepted connection crosses exactly one "
+        "decision.",
         labels=("outcome", "reason")),
     MetricDef(
         "etcd_tenant_inflight", "gauge",

@@ -41,6 +41,7 @@ from __future__ import annotations
 import heapq
 import json
 import logging
+import math
 import os
 import queue
 import re
@@ -461,7 +462,10 @@ def _response(status: int, body: bytes, headers: dict | None = None,
     return bytes(out)
 
 
-def _error_response(err: Exception, close: bool = False) -> bytes:
+def _error_parts(err: Exception) -> tuple[int, dict, bytes]:
+    """``(status, headers, body)`` for an error — assembled into a
+    response on the loop thread (via ``_reply``) so CORS headers get
+    injected there, same as every other reply."""
     if isinstance(err, EtcdError):
         body = (err.to_json() + "\n").encode()
         headers = {"Content-Type": "application/json",
@@ -471,9 +475,9 @@ def _error_response(err: Exception, close: bool = False) -> bytes:
             # pacing hint, and "0" invites an immediate retry storm
             headers["Retry-After"] = str(max(
                 1, int(err.retry_after + 0.999)))
-        return _response(err.http_status(), body, headers, close)
+        return err.http_status(), headers, body
     log.warning("frontdoor: internal error: %s", err)
-    return _response(500, b"Internal Server Error\n", None, close)
+    return 500, {}, b"Internal Server Error\n"
 
 
 class FrontDoor:
@@ -562,6 +566,9 @@ class FrontDoor:
     def shutdown(self) -> None:
         self._stopping = True
         self._wake()
+        # best-effort fast wakeup; a full queue may drop sentinels,
+        # in which case workers still exit via the _stopping flag
+        # within their get() timeout
         for _ in range(self.cfg.workers):
             try:
                 self._jobs.put_nowait(None)
@@ -658,7 +665,7 @@ class FrontDoor:
             for item in batch:
                 kind = item[0]
                 if kind == "resp":
-                    _k, conn, epoch, data, close = item
+                    _k, conn, epoch, parts, close = item
                     if conn.epoch != epoch or conn.mode != "busy":
                         continue  # conn was torn down meanwhile
                     if conn.tenant is not None:
@@ -666,7 +673,8 @@ class FrontDoor:
                         conn.tenant = None
                     conn.mode = "idle"
                     conn.close_after = conn.close_after or close
-                    self._queue_bytes(conn, data)
+                    status, headers, body = parts
+                    self._reply(conn, status, body, headers)
                     if conn.mode != "closed" \
                             and not conn.close_after:
                         self._process_rbuf(conn)
@@ -904,17 +912,8 @@ class FrontDoor:
                                           conn.close_after))
 
     def _reply_error(self, conn: _Conn, err: Exception) -> None:
-        if isinstance(err, EtcdError):
-            body = (err.to_json() + "\n").encode()
-            h = {"Content-Type": "application/json",
-                 "X-Etcd-Index": str(err.index)}
-            if isinstance(err, EtcdOverCapacity):
-                h["Retry-After"] = str(max(
-                    1, int(err.retry_after + 0.999)))
-            self._reply(conn, err.http_status(), body, h)
-        else:
-            log.warning("frontdoor: internal error: %s", err)
-            self._reply(conn, 500, b"Internal Server Error\n")
+        status, h, body = _error_parts(err)
+        self._reply(conn, status, body, h)
 
     # -- dispatch ----------------------------------------------------------
 
@@ -985,7 +984,9 @@ class FrontDoor:
             if "keepalive" in form:
                 try:
                     keepalive = float(form["keepalive"][0])
-                    if keepalive < 0:
+                    # non-finite values poison the timer heap (a NaN
+                    # at the top can never be popped)
+                    if keepalive < 0 or not math.isfinite(keepalive):
                         raise ValueError
                 except ValueError:
                     raise EtcdError(
@@ -1029,38 +1030,47 @@ class FrontDoor:
     # -- worker pool -------------------------------------------------------
 
     def _worker(self) -> None:
-        while True:
-            job = self._jobs.get()
+        # _stopping is the authoritative exit signal: the None
+        # sentinels shutdown() queues are best-effort wakeups that a
+        # full job queue may never deliver
+        while not self._stopping:
+            try:
+                job = self._jobs.get(timeout=0.5)
+            except queue.Empty:
+                continue
             if job is None:
                 return
             conn, epoch, rr = job
             try:
-                data = self._do_request(rr)
+                parts = self._do_request(rr)
             except Exception as e:  # pragma: no cover
                 log.exception("frontdoor: worker error")
-                data = _error_response(e)
-            self._post(("resp", conn, epoch, data, False))
+                parts = _error_parts(e)
+            self._post(("resp", conn, epoch, parts, False))
 
-    def _do_request(self, rr) -> bytes:
+    def _do_request(self, rr) -> tuple[int, dict, bytes]:
+        """``(status, headers, body)`` — the loop thread assembles
+        the wire response (and adds CORS headers) in the ``resp``
+        completion handler."""
         try:
             resp = self.etcd.do(rr, timeout=self.server_timeout)
         except EtcdError as e:
-            return _error_response(e)
+            return _error_parts(e)
         except TimeoutError:
-            return _error_response(EtcdError(
+            return _error_parts(EtcdError(
                 ECODE_RAFT_INTERNAL, "request timed out"))
         ev = resp.event
         if ev is None:  # pragma: no cover
-            return _error_response(
+            return _error_parts(
                 RuntimeError("no event in response"))
         body = (json.dumps(ev.to_dict()) + "\n").encode()
         status = 201 if ev.is_created() else 200
-        return _response(status, body, {
+        return status, {
             "Content-Type": "application/json",
             "X-Etcd-Index": str(ev.etcd_index),
             "X-Raft-Index": str(self.etcd.index()),
             "X-Raft-Term": str(self.etcd.term()),
-        })
+        }, body
 
     # -- watch serving (threadless) ----------------------------------------
 
@@ -1092,7 +1102,7 @@ class FrontDoor:
     def _start_single_watch(self, conn: _Conn, rr, tenant: str,
                             keepalive: float) -> None:
         if not self.admission.try_add_watches(tenant, 1):
-            self.admission._bill(SHED_ALL, "tenant_inflight")
+            self.admission._bill(SHED_ALL, "tenant_watches")
             self._reply_error(conn, EtcdOverCapacity(
                 cause=f"{tenant}: watch quota exhausted",
                 index=self.etcd.store.index(), retry_after=1.0))
@@ -1157,7 +1167,7 @@ class FrontDoor:
         # AT REGISTRATION — a quota breach is a typed 429 before the
         # stream opens, never a mid-stream eviction
         if not self.admission.try_add_watches(tenant, len(specs)):
-            self.admission._bill(SHED_ALL, "tenant_inflight")
+            self.admission._bill(SHED_ALL, "tenant_watches")
             self._reply_error(conn, EtcdOverCapacity(
                 cause=f"{tenant}: watch quota exhausted "
                       f"({len(specs)} requested)",

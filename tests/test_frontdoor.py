@@ -238,6 +238,66 @@ def test_live_parse_errors_are_typed(live):
     assert st == 400 and "errorCode" in json.loads(b)
 
 
+def test_live_nan_keepalive_rejected(live):
+    """Non-finite keepalive values must be a typed 400: keepalive=nan
+    passes a bare ``< 0`` check (NaN compares False) yet is truthy,
+    and a NaN-armed timer poisons the heap — the loop busy-spins and
+    every timer behind it stops firing."""
+    for bad in ("nan", "inf", "-inf", "-1"):
+        st, _, b = http(
+            "GET", live["base"] + "/v2/keys/fd/a?keepalive=" + bad)
+        assert st == 400
+        assert "keepalive" in json.loads(b)["cause"]
+    # a sane override still works
+    st, _, _ = http("GET", live["base"] + "/v2/keys/fd/a?keepalive=5")
+    assert st == 200
+
+
+def test_live_data_path_carries_cors(live):
+    """CORS headers ride every reply, including worker-built data
+    responses and errors — same contract as the threaded server."""
+    s = live["server"]
+    fd = FrontDoor(s, "127.0.0.1", 0, server_timeout=5.0,
+                   cors={"*"}).start()
+    try:
+        base = f"http://127.0.0.1:{fd.server_address[1]}"
+        org = {"Origin": "http://example.com"}
+        st, h, _ = http("PUT", base + "/v2/keys/fd/cors",
+                        {"value": "1"}, headers=org)
+        assert st == 201
+        assert h["Access-Control-Allow-Origin"] == "*"
+        st, h, _ = http("GET", base + "/v2/keys/fd/cors",
+                        headers=org)
+        assert st == 200
+        assert h["Access-Control-Allow-Origin"] == "*"
+        st, h, _ = http("GET", base + "/v2/keys/fd/missing",
+                        headers=org)
+        assert st == 404
+        assert h["Access-Control-Allow-Origin"] == "*"
+    finally:
+        fd.shutdown()
+
+
+def test_shutdown_survives_full_job_queue(live):
+    """Workers exit via the _stopping flag even when the job queue is
+    too full to deliver their None sentinels — no leaked threads."""
+    import queue as _q
+
+    s = live["server"]
+    fd = FrontDoor(s, "127.0.0.1", 0, server_timeout=5.0).start()
+
+    def always_full(item):
+        raise _q.Full
+
+    fd._jobs.put_nowait = always_full     # sentinels undeliverable
+    fd.shutdown()
+    workers = [t for t in fd._threads if "worker" in t.name]
+    assert workers
+    for t in workers:
+        t.join(2.0)
+    assert not any(t.is_alive() for t in workers)
+
+
 def test_live_429_carries_typed_vocabulary(live):
     """A shed request is a fast typed answer: HTTP 429, errorCode
     406, Retry-After header, tenant + reason in the cause."""
@@ -288,6 +348,10 @@ def test_live_watch_quota_rejected_at_register(live):
         doc = json.loads(ei.value.read().decode())
         assert doc["errorCode"] == ECODE_OVER_CAPACITY
         assert "watch quota" in doc["cause"]
+        # billed under its own reason — operators must be able to
+        # tell a watch-quota shed from a request-inflight shed
+        assert fd.admission.counts.get(
+            (SHED_ALL, "tenant_watches"), 0) == 1
         # a batch within quota registers fine, and the quota is
         # released at stream teardown
         req = urllib.request.Request(
@@ -412,6 +476,45 @@ def test_client_honors_retry_after_same_endpoint():
         with pytest.raises(ClientError) as ei:
             c0.get("/k")
         assert ei.value.code == 429
+    finally:
+        httpd.shutdown()
+
+
+def test_client_clamps_retry_after_hint(monkeypatch):
+    """A hostile/buggy ``Retry-After: 1e9`` must not park the caller
+    inside _request — the hint is clamped to the 30s backoff cap."""
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from etcd_tpu.api import Client, ClientError
+
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = b'{"errorCode": 406, "message": "shed"}'
+            self.send_response(429)
+            self.send_header("Retry-After", "1000000000")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = HTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    slept = []
+
+    class _FakeTime:                      # client.py only sleeps
+        sleep = staticmethod(slept.append)
+
+    monkeypatch.setattr("etcd_tpu.api.client.time", _FakeTime)
+    try:
+        ep = f"http://127.0.0.1:{httpd.server_address[1]}"
+        c = Client([ep], retries=1, timeout=5.0)
+        with pytest.raises(ClientError) as ei:
+            c.get("/k")
+        assert ei.value.code == 429
+        assert slept and all(s <= 30.0 for s in slept)
     finally:
         httpd.shutdown()
 
