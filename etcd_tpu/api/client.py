@@ -1,6 +1,15 @@
 """Python client library for the v2 API (reference client/http.go,
 client.go: Create/Get/Watch actions over HTTP with cancellable
-round trips and long-poll watchers)."""
+round trips and long-poll watchers).
+
+PR 14 adds the batch-endpoint methods (``get_many``/
+``propose_many``, the /mraft peer-tier lanes) with opportunistic
+binary framing: the client advertises ``Accept:
+application/x-etcd-batch`` on every batch call and upgrades to the
+fixed-width wire only after the server answers in kind — a
+JSON-only server (or proxy that strips the reply Content-Type)
+degrades the client to HTTP+JSON with zero failed ops, counted in
+``etcd_client_wire_fallback_total``, never silent."""
 
 from __future__ import annotations
 
@@ -10,7 +19,10 @@ import urllib.error
 import urllib.parse
 import urllib.request
 
+from ..obs import metrics as _obs
 from ..utils.backoff import Backoff
+from ..wire import clientmsg
+from ..wire.distmsg import FrameError
 
 
 class ClientError(Exception):
@@ -25,7 +37,8 @@ class Client:
     needs; ours adds delete/set for the CLI and tests)."""
 
     def __init__(self, endpoints: list[str], timeout: float = 5.0,
-                 tls_info=None, retries: int = 0):
+                 tls_info=None, retries: int = 0,
+                 wire: str = "auto"):
         """``tls_info`` (utils.transport.TLSInfo): client context for
         https endpoints — client-cert auth + CA verification
         (reference pkg/transport/listener.go:114-135).
@@ -43,6 +56,15 @@ class Client:
         self._ssl = None
         if tls_info is not None and not tls_info.empty():
             self._ssl = tls_info.client_context()
+        # batch-wire negotiation state (PR 14): "auto" advertises
+        # the binary framing and upgrades on the first binary reply;
+        # "binary" means negotiated (request bodies upgrade too);
+        # "json" is the sticky fallback — either forced by the
+        # caller or entered after a non-binary reply / decode error
+        # (counted in etcd_client_wire_fallback_total).
+        if wire not in ("auto", "json"):
+            raise ValueError(f"wire must be auto|json, got {wire!r}")
+        self._wire = wire
 
     # -- http --------------------------------------------------------------
 
@@ -50,7 +72,8 @@ class Client:
                  params: dict | None = None,
                  data: bytes | None = None,
                  content_type: str | None = None,
-                 timeout: float | None = None):
+                 timeout: float | None = None,
+                 accept: str | None = None):
         """One request attempt per endpoint until one connects: the
         single copy of the failover + error-vocabulary policy.
         Returns the OPEN response (caller reads or streams it);
@@ -81,6 +104,8 @@ class Client:
                                                  method=method)
                     if content_type:
                         req.add_header("Content-Type", content_type)
+                    if accept:
+                        req.add_header("Accept", accept)
                     try:
                         return urllib.request.urlopen(
                             req, timeout=timeout or self.timeout,
@@ -223,3 +248,103 @@ class Client:
             for line in resp:
                 if line.strip():
                     yield json.loads(line)
+
+    # -- batch endpoints (PR 14) -------------------------------------------
+
+    def _batch_post(self, path: str, body: bytes, content_type: str,
+                    timeout: float | None) -> tuple[bytes, bool]:
+        """POST one batch request, advertising the binary framing
+        unless the client is (or fell back to) JSON-only.  Returns
+        ``(reply bytes, reply was binary)`` and runs the negotiation
+        state machine: the first binary reply upgrades ``auto`` ->
+        ``binary``; a non-binary reply while we were hoping for (or
+        had negotiated) binary demotes to sticky ``json`` and counts
+        the downgrade — the mixed-version path is a metric, never a
+        failed op."""
+        acc = clientmsg.CONTENT_TYPE if self._wire != "json" else None
+        with self._request("POST", path, data=body,
+                           content_type=content_type,
+                           timeout=timeout, accept=acc) as resp:
+            rbody = resp.read()
+            rtype = resp.headers.get("Content-Type") or ""
+        binary = clientmsg.CONTENT_TYPE in rtype
+        if binary:
+            if self._wire == "auto":
+                self._wire = "binary"
+        elif self._wire != "json":
+            self._wire = "json"
+            _obs.registry.counter("etcd_client_wire_fallback_total",
+                                  reason="not_negotiated").inc()
+        _obs.registry.counter(
+            "etcd_client_wire_requests_total",
+            wire="binary" if binary else "json").inc()
+        return rbody, binary
+
+    def _wire_decode_error(self) -> None:
+        """A negotiated binary reply failed to parse (truncating
+        proxy, version skew mid-upgrade): fall back to JSON for the
+        rest of this client's life and count why."""
+        self._wire = "json"
+        _obs.registry.counter("etcd_client_wire_fallback_total",
+                              reason="decode_error").inc()
+
+    def get_many(self, paths: list[str], timeout: float | None = None
+                 ) -> tuple[list, dict[int, tuple[int, str]]]:
+        """Batched linearizable reads (POST /mraft/get_many, PR 7
+        lane).  Returns ``(vals, errs)``: ``vals[i]`` is the leaf
+        value (str) or None, ``errs`` maps failed indexes to
+        ``(errorCode, message)``.  The request body upgrades to the
+        DCB1 binary frame only after a reply has proven the server
+        speaks it; a decode failure retries once over JSON (reads
+        are idempotent)."""
+        if self._wire == "binary":
+            body = bytes(clientmsg.pack_get_request(paths))
+            ct = clientmsg.CONTENT_TYPE
+        else:
+            body = json.dumps(list(paths)).encode()
+            ct = "application/json"
+        rbody, binary = self._batch_post(
+            "/mraft/get_many", body, ct, timeout)
+        if binary:
+            try:
+                vals, errs = clientmsg.unpack_get_response(rbody)
+            except FrameError:
+                self._wire_decode_error()
+                return self.get_many(paths, timeout)
+            return ([v.decode() if isinstance(v, bytes) else v
+                     for v in vals], errs)
+        d = json.loads(rbody)
+        errs = {int(i): (int(e.get("errorCode", 300)),
+                         e.get("message", ""))
+                for i, e in (d.get("errs") or {}).items()}
+        return list(d.get("vals") or []), errs
+
+    def propose_many(self, reqs: list,
+                     timeout: float | None = None
+                     ) -> tuple[int, dict[int, tuple[int, str]]]:
+        """Batched writes (POST /mraft/propose_many).  ``reqs`` is a
+        list of ``wire.requests.Request``; returns ``(n, errs)`` with
+        the error-sparse verdict map.  The request body is the
+        version-stable packed-Request frame either way — only the
+        REPLY framing is negotiated, so a downgrade mid-stream can
+        never re-send (and double-apply) a write.  A reply that
+        negotiated binary but fails to decode raises (the writes may
+        have applied; re-proposing is not safe) after demoting the
+        client to JSON for subsequent calls."""
+        from ..server.distserver import pack_requests
+        rbody, binary = self._batch_post(
+            "/mraft/propose_many", pack_requests(reqs),
+            "application/octet-stream", timeout)
+        if binary:
+            try:
+                return clientmsg.unpack_propose_response(rbody)
+            except FrameError as e:
+                self._wire_decode_error()
+                raise ClientError(
+                    200, f"binary propose reply undecodable: {e}"
+                ) from None
+        d = json.loads(rbody)
+        errs = {int(i): (int(e.get("errorCode", 300)),
+                         e.get("message", ""))
+                for i, e in (d.get("errs") or {}).items()}
+        return int(d.get("n", 0)), errs

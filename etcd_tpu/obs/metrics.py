@@ -114,6 +114,24 @@ _DEFS = (
         "pipeline, PR 5; bounded by --dist-pipeline-depth).",
         labels=("peer",)),
     MetricDef(
+        "etcd_dist_pipeline_inflight_entries", "gauge",
+        "Entries (across all group lanes) in each peer's in-flight "
+        "append window — the multi-group frame-fusion evidence "
+        "(PR 14): entries-per-frame is this over "
+        "etcd_dist_pipeline_inflight.", labels=("peer",)),
+    MetricDef(
+        "etcd_client_wire_requests_total", "counter",
+        "Batch client requests by negotiated wire format (PR 14 "
+        "binary client protocol; json is the compatibility "
+        "default).", labels=("wire",)),
+    MetricDef(
+        "etcd_client_wire_fallback_total", "counter",
+        "Binary-capable client fell back to HTTP+JSON, by reason: "
+        "not_negotiated (server answered JSON — older peer or "
+        "ETCD_WIRE_BINARY=0) | decode_error (binary reply failed to "
+        "parse; sticky downgrade).  A mixed-version pair degrades "
+        "HERE, never into failed ops.", labels=("reason",)),
+    MetricDef(
         "etcd_dist_coalesce_entries", "histogram",
         "Client proposals coalesced per drain flush (adaptive "
         "cadence: max-entries/max-bytes threshold or the "

@@ -25,6 +25,7 @@ consensus state.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import numpy as np
@@ -32,7 +33,14 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..wire.distmsg import AppendBatch, AppendResp, VoteReq, VoteResp
+from ..wire.distmsg import (
+    AppendBatch,
+    AppendResp,
+    PackedPayloads,
+    VoteReq,
+    VoteResp,
+    flat_entry_table,
+)
 from .batched import (
     FOLLOWER,
     LEADER,
@@ -247,6 +255,12 @@ class DistMember:
         self.errors = {"overflow": np.zeros(g, bool),
                        "conflict": np.zeros(g, bool)}
         self._placer = None  # set by shard(): parallel.mesh placer
+        # PR 14: ship the FLAG_PACKED flat entry table on outgoing
+        # append frames (receivers consume entries in one flat pass).
+        # ETCD_DIST_PACKED=0 reverts to plain DGB2 frames — the
+        # mixed-version lever the compat tests drive.
+        self.packed_wire = \
+            os.environ.get("ETCD_DIST_PACKED", "1") != "0"
 
     # -- intra-host scale-out ---------------------------------------------
 
@@ -344,9 +358,11 @@ class DistMember:
         self.errors["overflow"] = overflow
         valid = lead & (np.asarray(n_new) > 0) & ~overflow
         if data is not None:
-            for gi in np.nonzero(valid)[0]:
+            pay = self.payloads
+            for gi in np.nonzero(valid)[0].tolist():
+                row, b0 = pay[gi], int(base[gi])
                 for j, blob in enumerate(data[gi][:int(n_new[gi])]):
-                    self.payloads[gi][int(base[gi]) + 1 + j] = blob
+                    row[b0 + 1 + j] = blob
         return valid, base
 
     def build_append(self, peer: int,
@@ -371,19 +387,22 @@ class DistMember:
         prev_idx = p[:, 2]
         n_ents = p[:, 3]
         terms2 = p[:, 6:]
-        payloads = []
-        for gi in range(self.g):
-            row = []
-            for j in range(int(n_ents[gi])):
-                row.append(self.payloads[gi].get(
-                    int(prev_idx[gi]) + 1 + j, b""))
-            payloads.append(row)
+        # flat fetch: one (group, gindex) table drives one pass over
+        # the payload ring — no per-group inner loop; the same table
+        # ships on the wire (FLAG_PACKED) so the follower stores flat
+        groups, gindex = flat_entry_table(prev_idx, n_ents)
+        pay = self.payloads
+        flat = [pay[gi].get(ix, b"")
+                for gi, ix in zip(groups.tolist(), gindex.tolist())]
         return AppendBatch(
             sender=self.slot, term=p[:, 4],
             prev_idx=prev_idx, prev_term=terms2[:, 0],
             n_ents=n_ents, commit=p[:, 5],
             active=active, need_snap=need_snap,
-            ent_terms=terms2[:, 1:], payloads=payloads)
+            ent_terms=terms2[:, 1:],
+            payloads=PackedPayloads.from_counts(flat, n_ents),
+            ent_group=groups if self.packed_wire else None,
+            ent_gindex=gindex if self.packed_wire else None)
 
     def ack_self(self, upto: np.ndarray) -> None:
         """Count this host's own DURABLE ack (pipelined mode):
@@ -459,10 +478,24 @@ class DistMember:
         self.errors["conflict"] = p[:, 2].astype(bool)
         self.errors["overflow"] = (self.errors["overflow"]
                                    | p[:, 3].astype(bool))
-        for gi in np.nonzero(ok_np)[0]:
-            for j in range(int(b.n_ents[gi])):
-                self.payloads[gi][int(b.prev_idx[gi]) + 1 + j] = \
-                    b.payloads[gi][j]
+        pay = self.payloads
+        if (b.ent_group is not None
+                and isinstance(b.payloads, PackedPayloads)):
+            # packed frame: the validated flat table routes every
+            # blob in ONE pass — mask by the accepting lanes, no
+            # per-group dict hop
+            groups = np.asarray(b.ent_group)
+            gl, il = groups.tolist(), \
+                np.asarray(b.ent_gindex).tolist()
+            flat = b.payloads.flat
+            for k in np.nonzero(ok_np[groups])[0].tolist():
+                pay[gl[k]][il[k]] = flat[k]
+        else:
+            for gi in np.nonzero(ok_np)[0].tolist():
+                row, b0 = pay[gi], int(b.prev_idx[gi])
+                blobs = b.payloads[gi]
+                for j in range(int(b.n_ents[gi])):
+                    row[b0 + 1 + j] = blobs[j]
         # A need_snap lane acks POSITIVELY at its commit (the
         # reference's handleSnapshot reply, raft.go:418-424): the
         # follower durably holds everything at or below its commit,

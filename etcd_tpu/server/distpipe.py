@@ -47,16 +47,20 @@ class FrameMeta:
     """One in-flight append frame's accounting record."""
 
     __slots__ = ("seq", "epoch", "t0", "nbytes", "has_ents", "stripe",
-                 "traced")
+                 "traced", "n_ents")
 
     def __init__(self, seq: int, epoch: int, t0: float, nbytes: int,
-                 has_ents: bool, stripe: int):
+                 has_ents: bool, stripe: int, n_ents: int = 0):
         self.seq = seq
         self.epoch = epoch
         self.t0 = t0
         self.nbytes = nbytes
         self.has_ents = has_ents
         self.stripe = stripe
+        # entries across all lanes of the frame: the multi-group
+        # fusion evidence (PR 14) — inflight_entries() exposes the
+        # window's entry depth, not just its frame count
+        self.n_ents = n_ents
         # the frame carries a distributed-trace block (PR 8): its
         # matched ack is a flight-recorder frame event (the
         # send/ack half of the stitcher's clock-alignment pairs)
@@ -96,7 +100,8 @@ class AppendPipeline:
         return len(pp.inflight) < self.depth
 
     def register(self, peer: int, *, t0: float, nbytes: int,
-                 has_ents: bool, stripe: int) -> FrameMeta:
+                 has_ents: bool, stripe: int,
+                 n_ents: int = 0) -> FrameMeta:
         """Allocate the next seq for ``peer`` and record the frame as
         in flight; the caller stamps (seq, epoch) into the frame and
         hands it to the transport."""
@@ -104,7 +109,7 @@ class AppendPipeline:
         seq = pp.next_seq
         pp.next_seq = (seq + 1) & 0x7FFFFFFF or 1
         meta = FrameMeta(seq, self.epoch, t0, nbytes, has_ents,
-                         stripe)
+                         stripe, n_ents)
         pp.inflight[seq] = meta
         pp.last_send[stripe] = t0
         return meta
@@ -114,6 +119,12 @@ class AppendPipeline:
 
     def inflight(self, peer: int) -> int:
         return len(self._peers[peer].inflight)
+
+    def inflight_entries(self, peer: int) -> int:
+        """Entries (not frames) in the peer's window — how much the
+        multi-group fusion amortizes each frame's fixed cost."""
+        return sum(m.n_ents
+                   for m in self._peers[peer].inflight.values())
 
     def inflight_total(self) -> int:
         return sum(len(pp.inflight) for pp in self._peers.values())

@@ -69,9 +69,13 @@ from ..utils.trace import tracer
 from ..utils.wait import Chan, Wait
 from ..wal import WAL, exist as wal_exist
 from ..wire import Entry, GroupEntry, HardState, Snapshot
+from ..wire.proto import marshal_group_entries
+from ..wire import clientmsg
 from ..wire.distmsg import (
     AppendBatch,
     AppendResp,
+    FrameError,
+    PackedPayloads,
     VoteReq,
     VoteResp,
     unmarshal_any,
@@ -426,6 +430,16 @@ class DistServer:
             p: _obs.registry.gauge("etcd_dist_pipeline_inflight",
                                    peer=str(p))
             for p in range(self.m) if p != slot}
+        self._m_inflight_ents = {
+            p: _obs.registry.gauge(
+                "etcd_dist_pipeline_inflight_entries", peer=str(p))
+            for p in range(self.m) if p != slot}
+        # PR 14: answer batch endpoints in the binary client framing
+        # (wire/clientmsg.py) when the request advertises it via
+        # Accept.  ETCD_WIRE_BINARY=0 simulates a JSON-only server —
+        # the mixed-version arm of the negotiation compat tests.
+        self.wire_binary = \
+            os.environ.get("ETCD_WIRE_BINARY", "1") != "0"
 
         # -- linearizable read path (PR 7) ----------------------------
         # Lease band: the lease may only vouch for leadership while
@@ -930,20 +944,73 @@ class DistServer:
                 raise
 
     def _entry_records(self, gis, base, items) -> list[Entry]:
-        """WAL records for entries appended at this host."""
+        """WAL records for entries appended at this host: one flat
+        (group, gindex, gterm, payload) table batch-marshaled via
+        ``marshal_group_entries`` — no per-record GroupEntry object
+        (PR 14: the record builder was the propose path's top
+        allocation line after the engine fusion)."""
         terms = self.mr.terms()
-        out = []
-        for gi in gis:
+        groups: list[int] = []
+        gindex: list[int] = []
+        gterms: list[int] = []
+        blobs: list[bytes] = []
+        for gi in np.asarray(gis).tolist():
+            b0, t = int(base[gi]), int(terms[gi])
             for j, p in enumerate(items[gi]):
-                self.seq += 1
-                out.append(Entry(
-                    index=self.seq, term=self.raft_term,
-                    data=GroupEntry(
-                        kind=K_ENTRY, group=int(gi),
-                        gindex=int(base[gi]) + 1 + j,
-                        gterm=int(terms[gi]),
-                        payload=p.data).marshal()))
-        return out
+                groups.append(gi)
+                gindex.append(b0 + 1 + j)
+                gterms.append(t)
+                blobs.append(p.data)
+        return self._seal_records(
+            marshal_group_entries(K_ENTRY, groups, gindex, gterms,
+                                  blobs))
+
+    def _seal_records(self, datas: list[bytes]) -> list[Entry]:
+        """Wrap batch-marshaled GroupEntry blobs in WAL Entries with
+        one vectorized seq allocation."""
+        self.seq += len(datas)
+        seq0 = self.seq - len(datas)
+        rt = self.raft_term
+        return [Entry(index=seq0 + 1 + i, term=rt, data=d)
+                for i, d in enumerate(datas)]
+
+    def _frame_entry_records(self, msg: AppendBatch,
+                             appended) -> list[Entry]:
+        """WAL records for the entries an inbound frame appended.
+        A packed frame (FLAG_PACKED) drives ONE flat pass over the
+        validated entry table — mask by the accepting lanes, batch-
+        marshal, done; the unpacked fallback walks per group."""
+        if (msg.ent_group is not None
+                and isinstance(msg.payloads, PackedPayloads)):
+            groups = np.asarray(msg.ent_group)
+            keep = np.nonzero(np.asarray(appended)[groups])[0]
+            if not keep.size:
+                return []
+            gl = groups[keep]
+            il = np.asarray(msg.ent_gindex)[keep]
+            # ent_terms[g, j] with j = gindex - prev_idx[g] - 1;
+            # in-range by the unmarshal-time table validation
+            j = il - np.asarray(msg.prev_idx)[gl] - 1
+            gterms = np.asarray(msg.ent_terms)[gl, j]
+            flat = msg.payloads.flat
+            return self._seal_records(marshal_group_entries(
+                K_ENTRY, gl.tolist(), il.tolist(), gterms.tolist(),
+                [flat[k] for k in keep.tolist()]))
+        groups = []
+        gindex = []
+        gterms = []
+        blobs = []
+        for gi in np.nonzero(appended)[0].tolist():
+            p0 = int(msg.prev_idx[gi])
+            row = msg.payloads[gi]
+            for j in range(int(msg.n_ents[gi])):
+                groups.append(gi)
+                gindex.append(p0 + 1 + j)
+                gterms.append(int(msg.ent_terms[gi, j]))
+                blobs.append(row[j])
+        return self._seal_records(
+            marshal_group_entries(K_ENTRY, groups, gindex, gterms,
+                                  blobs))
 
     # -- peer RPC (HTTP handler entry points) -----------------------------
 
@@ -1009,18 +1076,8 @@ class DistServer:
                 ballot0 = self._ballot
                 with tracer.stage("dist.frame_records"):
                     recs = self._ballot_record()
-                    for gi in np.nonzero(resp.appended)[0]:
-                        for j in range(int(msg.n_ents[gi])):
-                            self.seq += 1
-                            recs.append(Entry(
-                                index=self.seq, term=self.raft_term,
-                                data=GroupEntry(
-                                    kind=K_ENTRY, group=int(gi),
-                                    gindex=int(msg.prev_idx[gi])
-                                    + 1 + j,
-                                    gterm=int(msg.ent_terms[gi, j]),
-                                    payload=msg.payloads[gi][j])
-                                .marshal()))
+                    recs.extend(self._frame_entry_records(
+                        msg, resp.appended))
                 try:
                     with tracer.stage("dist.frame_persist"):
                         self._persist(recs)
@@ -1396,7 +1453,10 @@ class DistServer:
             applied=self.applied, floor=self._read_floor,
             basis=basis, lease_until=basis + self._lease_s, now=now)
         if released:
-            self._m_ri_batch.observe(len(released))
+            # weight by the reads riding each registration: a
+            # read_many batch shares one channel per group (PR 14)
+            self._m_ri_batch.observe(
+                sum(pr.n for pr, _path, _rd in released))
             for pr, path, rd in released:
                 pr.ch.close((path, rd))
 
@@ -1612,7 +1672,11 @@ class DistServer:
             else:
                 linz.append((i, r.path, r))
         fast: list[tuple[int, str, Request | None]] = []
-        chans: list[tuple[int, str, Request | None, Chan]] = []
+        # ONE Chan + ONE queue registration per GROUP, not per read:
+        # the group's confirmation covers every read that registered
+        # under it, and the stage tables flagged the per-read Chan
+        # as the register loop's top allocation (PR 14 hoist)
+        group_chans: dict[int, tuple[Chan, object, list]] = {}
         followers: dict[int,
                         list[tuple[int, str, Request | None]]] = {}
         if linz:
@@ -1631,10 +1695,15 @@ class DistServer:
                     if ok:
                         fast.append((i, path, r))
                     elif self._prev_lead[gi]:
-                        ch = Chan()
-                        self._reads.register(
-                            gi, t0, int(self.applied[gi]), ch)
-                        chans.append((i, path, r, ch))
+                        ent = group_chans.get(gi)
+                        if ent is None:
+                            ch = Chan()
+                            pr = self._reads.register(
+                                gi, t0, int(self.applied[gi]), ch)
+                            ent = group_chans[gi] = (ch, pr, [])
+                        else:
+                            ent[1].n += 1
+                        ent[2].append((i, path, r))
                     else:
                         followers.setdefault(gi, []).append(
                             (i, path, r))
@@ -1642,7 +1711,7 @@ class DistServer:
                     # the batch IS a confirmation sweep: one lease
                     # check per group released this many reads
                     self._m_ri_batch.observe(len(fast))
-                if chans:
+                if group_chans:
                     self._read_release(now)
                     if self._reads.pending:
                         self._nudge_reads(now)
@@ -1664,16 +1733,19 @@ class DistServer:
         deadline = (None if timeout is None
                     else time.monotonic() + timeout)
         served: dict[str, int] = {}
-        for i, path, r, ch in chans:
+        for _gi, (ch, _pr, items) in group_chans.items():
             left = (None if deadline is None
                     else max(0.0, deadline - time.monotonic()))
             try:
                 p = self._await_read(ch, left, "read_index", t0)[0]
             except (TimeoutError, ServerStoppedError) as e:
-                out[i] = e
+                for i, _path, _r in items:
+                    out[i] = e
                 continue
-            served[p] = served.get(p, 0) + 1
-            out[i] = self._serve_read(path, r)
+            # the group's one confirmation covers its whole batch
+            served[p] = served.get(p, 0) + len(items)
+            for i, path, r in items:
+                out[i] = self._serve_read(path, r)
         for p, n in served.items():
             self._count_read(p, "ok", n=n)
         if served:
@@ -2267,6 +2339,8 @@ class DistServer:
 
     def _set_inflight(self, peer: int) -> None:
         self._m_inflight[peer].set(self.pipe.inflight(peer))
+        self._m_inflight_ents[peer].set(
+            self.pipe.inflight_entries(peer))
 
     def _pump_all(self) -> None:
         for peer in range(self.m):
@@ -2339,7 +2413,7 @@ class DistServer:
                         break
                 meta = self.pipe.register(
                     peer, t0=now, nbytes=0, has_ents=has_ents,
-                    stripe=stripe)
+                    stripe=stripe, n_ents=int(n_ents.sum()))
                 b.seq, b.epoch = meta.seq, self.pipe.epoch
                 mr.optimistic_advance(peer, b)
                 if has_ents and self._trace_live:
@@ -2359,7 +2433,8 @@ class DistServer:
                         meta.traced = True
                         self._traced_send[(peer, meta.seq)] = \
                             [[t[2], t[3]] for t in tr]
-                payload = b.marshal()
+                with tracer.stage("dist.frame_marshal"):
+                    payload = b.marshal()
                 meta.nbytes = len(payload)
                 self._m_frames.inc()
                 self.server_stats.send_append()
@@ -3420,19 +3495,45 @@ def _make_peer_handler(server: DistServer):
                     # — because at window 512 a per-request verdict
                     # list made the leader encode (and every client
                     # decode) ~12 KB of JSON per batch on the serving
-                    # core; the common all-ok batch is now ~20 bytes
+                    # core; the common all-ok batch is now ~20 bytes.
+                    # A client that advertised the binary framing
+                    # (Accept, PR 14) gets the fixed-width DCB1 form
+                    # instead — 16 bytes all-ok, no JSON encode.
                     try:
-                        reqs = unpack_requests(self._body())
+                        # the propose BODY is the version-stable
+                        # packed Request batch on every wire (a
+                        # downgrade must never re-send a write), so
+                        # its parse is ingest cost, not client-wire
+                        # cost — attributed apart from the
+                        # Accept-negotiated client.* stages
+                        with tracer.stage("dist.parse_batch"):
+                            reqs = unpack_requests(self._body())
                         res = server.do_many(reqs, timeout=30.0)
-                        errs = {}
-                        for i, x in enumerate(res):
-                            if not isinstance(x, Response):
-                                errs[str(i)] = {
-                                    "errorCode": getattr(
-                                        x, "error_code", 300),
-                                    "message": str(x)}
-                        self._reply(200, json.dumps(
-                            {"n": len(res), "errs": errs}).encode())
+                        if self._binary_ok():
+                            with tracer.stage("client.marshal"):
+                                body = bytes(
+                                    clientmsg.pack_propose_response(
+                                        len(res),
+                                        {i: (getattr(x, "error_code",
+                                                     300), str(x))
+                                         for i, x in enumerate(res)
+                                         if not isinstance(
+                                             x, Response)}))
+                            self._reply(200, body,
+                                        ctype=clientmsg.CONTENT_TYPE)
+                            return
+                        with tracer.stage("client.marshal"):
+                            errs = {}
+                            for i, x in enumerate(res):
+                                if not isinstance(x, Response):
+                                    errs[str(i)] = {
+                                        "errorCode": getattr(
+                                            x, "error_code", 300),
+                                        "message": str(x)}
+                            body = json.dumps(
+                                {"n": len(res),
+                                 "errs": errs}).encode()
+                        self._reply(200, body)
                     except Exception as e:
                         self._reply(400, json.dumps(
                             {"ok": False,
@@ -3461,23 +3562,33 @@ def _make_peer_handler(server: DistServer):
                     # PR 7 batched zero-WAL read lane (the GET
                     # analog of propose_many): values ride back so
                     # read-burst drivers (bench, chaos linz gate)
-                    # can check what they observed.  Body is either
-                    # a JSON array of path strings (the compact
-                    # form — a read's wire cost is its key) or a
-                    # packed Request batch (flagged reads).
+                    # can check what they observed.  Body is a JSON
+                    # array of path strings (the compact form — a
+                    # read's wire cost is its key), a binary DCB1
+                    # path frame (PR 14, magic-sniffed), or a packed
+                    # Request batch (flagged reads).
                     try:
                         body = self._body()
                         if body[:1] == b"[":
-                            reqs = json.loads(body)
-                            if not all(isinstance(p, str)
-                                       for p in reqs):
-                                raise ValueError(
-                                    "path list must be strings")
+                            with tracer.stage("client.parse"):
+                                reqs = json.loads(body)
+                                if not all(isinstance(p, str)
+                                           for p in reqs):
+                                    raise ValueError(
+                                        "path list must be strings")
+                        elif body[:4] == b"DCB1":
+                            with tracer.stage("client.parse"):
+                                reqs = clientmsg.unpack_get_request(
+                                    body)
                         else:
-                            reqs = unpack_requests(body)
+                            # flagged reads ride the version-stable
+                            # packed batch — ingest cost, like the
+                            # propose body
+                            with tracer.stage("dist.parse_batch"):
+                                reqs = unpack_requests(body)
                         res = server.read_many(reqs, timeout=30.0)
                         vals: list = []
-                        errs = {}
+                        errs_b: dict = {}
                         for i, x in enumerate(res):
                             if isinstance(x, Response):
                                 ev = x.event
@@ -3487,17 +3598,32 @@ def _make_peer_handler(server: DistServer):
                                     else None)
                             elif isinstance(x, Exception):
                                 vals.append(None)
-                                errs[str(i)] = {
-                                    "errorCode": getattr(
-                                        x, "error_code", 300),
-                                    "message": str(x)}
+                                errs_b[i] = (getattr(
+                                    x, "error_code", 300), str(x))
                             else:
                                 # compact path-string entry: the raw
                                 # leaf value (None for a directory)
                                 vals.append(x)
-                        self._reply(200, json.dumps(
-                            {"n": len(res), "vals": vals,
-                             "errs": errs}).encode())
+                        if self._binary_ok():
+                            with tracer.stage("client.marshal"):
+                                # the codec takes str leaf values
+                                # directly and encodes chunk-wise
+                                # into the one output buffer; no
+                                # bytes() re-copy of a KB-scale body
+                                out = clientmsg.pack_get_response(
+                                    vals, errs_b)
+                            self._reply(200, out,
+                                        ctype=clientmsg.CONTENT_TYPE)
+                            return
+                        with tracer.stage("client.marshal"):
+                            out = json.dumps(
+                                {"n": len(res), "vals": vals,
+                                 "errs": {
+                                     str(i): {"errorCode": c,
+                                              "message": m}
+                                     for i, (c, m)
+                                     in errs_b.items()}}).encode()
+                        self._reply(200, out)
                     except ServerStoppedError:
                         self._reply(503, b"")
                     except Exception as e:
@@ -3554,8 +3680,21 @@ def _make_peer_handler(server: DistServer):
             else:
                 self._reply(404, b"")
 
-        def _reply(self, code: int, body: bytes) -> None:
+        def _binary_ok(self) -> bool:
+            """Negotiation gate: answer in the binary client framing
+            only when this server speaks it AND the request's Accept
+            header asked for it (a JSON-only client never sees a
+            binary byte; a binary client against a JSON-only server
+            reads the missing reply Content-Type as 'negotiate
+            down')."""
+            return (server.wire_binary and clientmsg.CONTENT_TYPE
+                    in (self.headers.get("Accept") or ""))
+
+        def _reply(self, code: int, body: bytes,
+                   ctype: str | None = None) -> None:
             self.send_response(code)
+            if ctype is not None:
+                self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             if body:

@@ -110,15 +110,22 @@ class LeaseClock:
 
 
 class PendingRead:
-    """One registered linearizable read (or ReadIndex RPC)."""
+    """One registered linearizable read (or ReadIndex RPC).
 
-    __slots__ = ("t0", "required", "ch", "kind")
+    ``n`` counts the reads riding this registration: a read_many
+    batch registers ONE channel per group and folds the group's
+    remaining reads into it (PR 14 — the per-read Chan allocation
+    was a stage-table line), so release sweeps weight their batch
+    metric by ``n``, not the queue length."""
+
+    __slots__ = ("t0", "required", "ch", "kind", "n")
 
     def __init__(self, t0: float, required: int, ch, kind: str):
         self.t0 = t0            # registration time (monotonic)
         self.required = required  # leader applied at registration
         self.ch = ch            # utils.wait.Chan
         self.kind = kind        # "read" | "rd" (follower RPC)
+        self.n = 1              # reads sharing this registration
 
 
 class ReadQueue:

@@ -68,6 +68,24 @@ KIND_PROPOSE = 4
 # layout, so new peers interop with old senders for free.
 FLAG_TRACE = 0x0001
 
+# FLAG_PACKED (PR 14): the frame carries an OPTIONAL flat entry
+# table AFTER the payload blobs (and after the trace block when both
+# are present — trailing sections appear in flag-bit order):
+#
+#   u32 total | ent_group [total] i32 | ent_gindex [total] i32
+#
+# One row per carried entry, in frame order: the group lane and the
+# absolute group index (prev_idx[g]+1+j) of each payload blob.  The
+# receiver's serving loop consumes entries FLAT — one pass over the
+# table builds every WAL record and stores every payload without a
+# per-group dict hop.  The table is redundant with (prev_idx,
+# n_ents), which is exactly why it is validated on unmarshal (count,
+# range, per-lane histogram): a corrupted table cannot disagree with
+# the [G] sections without failing typed as FrameError.  Same
+# structural versioning as FLAG_TRACE: old peers ignore the bit and
+# the trailing bytes; an unpacked frame is byte-identical to DGB2.
+FLAG_PACKED = 0x0002
+
 #: one trace entry: group i32, gindex i32, trace_id u32, origin u8
 #: (+3 pad — keeps entries 16-byte and the block 4-aligned)
 _TRACE_ENT = struct.Struct("<iiIBxxx")
@@ -125,7 +143,8 @@ def parse_header(data) -> tuple[int, int, int, int, int, int, int]:
     return kind, sender, g, e, seq, epoch, flags
 
 
-def _read_trace(data, pos: int) -> list[tuple[int, int, int, int]]:
+def _read_trace(
+        data, pos: int) -> tuple[list[tuple[int, int, int, int]], int]:
     """Parse the optional trailing trace block at ``pos`` (the
     FLAG_TRACE bit was set).  Raises FrameError on truncation or an
     implausible count — a flipped flag bit must fail typed, never
@@ -144,7 +163,91 @@ def _read_trace(data, pos: int) -> list[tuple[int, int, int, int]]:
         g, gi, tid, org = _TRACE_ENT.unpack_from(data, pos)
         out.append((g, gi, tid, org))
         pos += _TRACE_ENT.size
-    return out
+    return out, pos
+
+
+def _read_packed(data, pos: int, prev_idx, n_ents,
+                 total: int) -> tuple[np.ndarray, np.ndarray, int]:
+    """Parse + validate the trailing FLAG_PACKED entry table.  The
+    table is fully determined by (prev_idx, n_ents) — row k of lane
+    g MUST be (g, prev_idx[g]+1+j) in frame order — so it is checked
+    for exact equality against the recomputed layout: a mutated
+    table fails typed here instead of mis-routing entries in the
+    receiver's flat store loop, and downstream consumers may index
+    ent_terms[group, gindex-prev_idx-1] without re-validating."""
+    if pos + 4 > len(data):
+        raise FrameError("truncated packed table")
+    (n,) = struct.unpack_from("<I", data, pos)
+    pos += 4
+    if n != total:
+        raise FrameError(
+            f"packed table count {n} != sum(n_ents) {total}")
+    groups, pos = _view_i32(data, pos, n)
+    gindex, pos = _view_i32(data, pos, n)
+    exp_g, exp_i = flat_entry_table(prev_idx, n_ents)
+    if not (np.array_equal(groups, exp_g)
+            and np.array_equal(gindex, exp_i)):
+        raise FrameError("packed table disagrees with [G] sections")
+    return groups, gindex, pos
+
+
+class PackedPayloads:
+    """Flat payload storage for an AppendBatch: one ``list[bytes]``
+    in frame order plus a [G+1] starts table (cumsum of n_ents).
+    Indexing by group returns that lane's blob list, so existing
+    per-group consumers keep working, but batch consumers iterate
+    ``flat`` directly — no nested list-of-lists allocation per frame.
+    ``unmarshal`` always returns this form."""
+
+    __slots__ = ("flat", "starts")
+
+    def __init__(self, flat: list[bytes], starts: np.ndarray):
+        self.flat = flat
+        self.starts = starts
+
+    @classmethod
+    def from_counts(cls, flat: list[bytes],
+                    n_ents) -> "PackedPayloads":
+        n = np.asarray(n_ents, np.int64)
+        starts = np.zeros(n.shape[0] + 1, np.int64)
+        np.cumsum(n, out=starts[1:])
+        return cls(flat, starts)
+
+    def __len__(self) -> int:
+        return self.starts.shape[0] - 1
+
+    def __getitem__(self, gi: int) -> list[bytes]:
+        return self.flat[int(self.starts[gi]):
+                         int(self.starts[gi + 1])]
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, PackedPayloads):
+            return (self.flat == other.flat
+                    and np.array_equal(self.starts, other.starts))
+        if isinstance(other, (list, tuple)):
+            return (len(other) == len(self)
+                    and all(self[gi] == list(other[gi])
+                            for gi in range(len(self))))
+        return NotImplemented
+
+    def __repr__(self) -> str:  # debugging aid only
+        return f"PackedPayloads({len(self.flat)} blobs/{len(self)} groups)"
+
+
+def flat_entry_table(prev_idx,
+                     n_ents) -> tuple[np.ndarray, np.ndarray]:
+    """Build the FLAG_PACKED (ent_group, ent_gindex) table for a
+    frame carrying n_ents[g] entries per lane starting at
+    prev_idx[g]+1 — all vectorized, no per-group host loop."""
+    n = np.asarray(n_ents, np.int64)
+    g = n.shape[0]
+    total = int(n.sum())
+    starts = np.zeros(g + 1, np.int64)
+    np.cumsum(n, out=starts[1:])
+    groups = np.repeat(np.arange(g, dtype=np.int32), n)
+    j = np.arange(total, dtype=np.int64) - starts[groups]
+    gindex = np.asarray(prev_idx, np.int64)[groups] + 1 + j
+    return groups, gindex.astype(np.int32)
 
 
 def _write_trace(buf: bytearray, pos: int, trace) -> int:
@@ -181,31 +284,45 @@ class AppendBatch:
     active: np.ndarray      # [G] bool
     need_snap: np.ndarray   # [G] bool
     ent_terms: np.ndarray   # [G, E] i32
-    payloads: list[list[bytes]] = field(default_factory=list)
+    payloads: "list[list[bytes]] | PackedPayloads" = \
+        field(default_factory=list)
     seq: int = 0
     epoch: int = 0
     #: optional distributed-trace block (PR 8): (group, gindex,
     #: trace_id, origin) per head-sampled entry this frame carries.
     #: None/[] marshals the exact pre-trace layout (flags=0).
     trace: list[tuple[int, int, int, int]] | None = None
+    #: optional FLAG_PACKED flat entry table (PR 14): the group lane
+    #: and absolute group index of each carried payload, frame order.
+    #: Both or neither; None marshals the exact DGB2 layout.
+    ent_group: np.ndarray | None = None   # [total] i32
+    ent_gindex: np.ndarray | None = None  # [total] i32
 
     def marshal(self) -> bytearray:
         g = self.term.shape[0]
         e = self.ent_terms.shape[1] if self.ent_terms.size else 0
         n_ents = np.asarray(self.n_ents)
-        lens: list[int] = []
-        blob_total = 0
-        for gi in range(g):
-            row = self.payloads[gi] if self.payloads else []
-            for j in range(int(n_ents[gi])):
-                ln = len(row[j]) if j < len(row) else 0
-                lens.append(ln)
-                blob_total += ln
+        flat: list[bytes]
+        if isinstance(self.payloads, PackedPayloads):
+            flat = self.payloads.flat
+            if len(flat) != int(n_ents.sum()):
+                raise FrameError("payloads disagree with n_ents")
+        else:
+            flat = []
+            for gi in range(g):
+                row = self.payloads[gi] if self.payloads else []
+                for j in range(int(n_ents[gi])):
+                    flat.append(row[j] if j < len(row) else b"")
+        lens = [len(b) for b in flat]
+        blob_total = sum(lens)
         trace = self.trace or None
-        flags = FLAG_TRACE if trace else 0
+        packed = self.ent_group is not None
+        flags = ((FLAG_TRACE if trace else 0)
+                 | (FLAG_PACKED if packed else 0))
         tr_bytes = (4 + _TRACE_ENT.size * len(trace)) if trace else 0
+        pk_bytes = (4 + 8 * len(lens)) if packed else 0
         out = bytearray(_HDR.size + (5 * g + g * e + len(lens)) * 4
-                        + 2 * g + blob_total + tr_bytes)
+                        + 2 * g + blob_total + tr_bytes + pk_bytes)
         _HDR.pack_into(out, 0, _MAGIC, KIND_APPEND, self.sender,
                        flags, g, e, self.seq & 0xFFFFFFFF,
                        self.epoch & 0xFFFFFFFF)
@@ -219,14 +336,16 @@ class AppendBatch:
         pos = _w_i32(out, pos, np.asarray(lens, "<i4"))
         pos = _w_u8(out, pos, self.active)
         pos = _w_u8(out, pos, self.need_snap)
-        for gi in range(g):
-            row = self.payloads[gi] if self.payloads else []
-            for j in range(int(n_ents[gi])):
-                b = row[j] if j < len(row) else b""
-                out[pos:pos + len(b)] = b
-                pos += len(b)
+        for b in flat:
+            out[pos:pos + len(b)] = b
+            pos += len(b)
         if trace:
             pos = _write_trace(out, pos, trace)
+        if packed:
+            struct.pack_into("<I", out, pos, len(lens))
+            pos += 4
+            pos = _w_i32(out, pos, self.ent_group)
+            pos = _w_i32(out, pos, self.ent_gindex)
         return out
 
     @classmethod
@@ -252,26 +371,31 @@ class AppendBatch:
         active, pos = _view_u8(data, pos, g)
         need_snap, pos = _view_u8(data, pos, g)
         buf = memoryview(data)
-        payloads: list[list[bytes]] = []
-        li = 0
-        for gi in range(g):
-            row = []
-            for _ in range(int(n_ents[gi])):
-                ln = int(lens[li])
-                if ln < 0 or pos + ln > len(data):
-                    raise FrameError("truncated payload blob")
-                li += 1
-                row.append(bytes(buf[pos:pos + ln]))
-                pos += ln
-            payloads.append(row)
-        trace = (_read_trace(data, pos) if flags & FLAG_TRACE
-                 else None)
+        # flat single-loop payload parse: blob order on the wire IS
+        # frame order, so there is no per-group inner loop to run —
+        # the nested view is recovered lazily via PackedPayloads
+        flat: list[bytes] = []
+        for li in range(total):
+            ln = int(lens[li])
+            if ln < 0 or pos + ln > len(data):
+                raise FrameError("truncated payload blob")
+            flat.append(bytes(buf[pos:pos + ln]))
+            pos += ln
+        payloads = PackedPayloads.from_counts(flat, n_ents)
+        trace = None
+        if flags & FLAG_TRACE:
+            trace, pos = _read_trace(data, pos)
+        ent_group = ent_gindex = None
+        if flags & FLAG_PACKED:
+            ent_group, ent_gindex, pos = _read_packed(
+                data, pos, prev_idx, n_ents, total)
         return cls(sender=sender, term=term, prev_idx=prev_idx,
                    prev_term=prev_term, n_ents=n_ents, commit=commit,
                    active=active.astype(bool),
                    need_snap=need_snap.astype(bool),
                    ent_terms=ets.reshape(g, e), payloads=payloads,
-                   seq=seq, epoch=epoch, trace=trace)
+                   seq=seq, epoch=epoch, trace=trace,
+                   ent_group=ent_group, ent_gindex=ent_gindex)
 
 
 @dataclass

@@ -64,6 +64,17 @@ if "--seed" in _argv:
     _si = _argv.index("--seed")
     NEMESIS_SEED = int(_argv[_si + 1])
     _argv = _argv[:_si] + _argv[_si + 2:]
+# --wire json|binary (PR 14): the client batch framing the drill's
+# put_batch / get_many burst traffic rides; extracted like --seed
+# (index + splice before the bare-digit scan) so its value can never
+# be mistaken for the CYCLES positional
+WIRE = "json"
+if "--wire" in _argv:
+    _wi = _argv.index("--wire")
+    WIRE = _argv[_wi + 1]
+    if WIRE not in ("json", "binary"):
+        raise SystemExit(f"--wire must be json|binary, got {WIRE!r}")
+    _argv = _argv[:_wi] + _argv[_wi + 2:]
 _pos = [a for a in _argv if a.isdigit()]
 CYCLES = int(_pos[0]) if _pos else 6
 deep_lag = "--deep-lag" in sys.argv
@@ -134,20 +145,30 @@ _BID = [1 << 48]
 
 def put_batch(slot, items, timeout=20):
     """One /mraft/propose_many frame of (key, val) writes against the
-    PEER port of ``slot``; returns the per-item ok verdicts."""
+    PEER port of ``slot``; returns the per-item ok verdicts.  With
+    ``--wire binary`` the reply rides the DCB1 framing (the request
+    body is the version-stable packed form either way)."""
     from etcd_tpu.server.distserver import pack_requests
+    from etcd_tpu.wire import clientmsg
     from etcd_tpu.wire.requests import Request
 
     reqs = []
     for k, v in items:
         _BID[0] += 1
         reqs.append(Request(method="PUT", id=_BID[0], path=k, val=v))
+    hdrs = {"Content-Type": "application/octet-stream"}
+    if WIRE == "binary":
+        hdrs["Accept"] = clientmsg.CONTENT_TYPE
     req = urllib.request.Request(
         PEERS[slot] + "/mraft/propose_many",
-        data=pack_requests(reqs), method="POST",
-        headers={"Content-Type": "application/octet-stream"})
+        data=pack_requests(reqs), method="POST", headers=hdrs)
     with urllib.request.urlopen(req, timeout=timeout) as r:
-        out = json.loads(r.read())
+        data = r.read()
+        rtype = r.headers.get("Content-Type") or ""
+    if clientmsg.CONTENT_TYPE in rtype:
+        n, berrs = clientmsg.unpack_propose_response(data)
+        return [i not in berrs for i in range(n)]
+    out = json.loads(data)
     errs = out.get("errs", {})
     return [str(i) not in errs for i in range(out["n"])]
 
@@ -511,21 +532,35 @@ def linz_drill(cycles: int) -> None:
         # the post-kill window they pile into the ReadIndex queue
         # and release together on the new leader's first confirmed
         # round
+        from etcd_tpu.wire import clientmsg
+
         batch = [f"{KEYS[j % len(KEYS)]}lz{j % N_CLIENTS}"
                  for j in range(64)]
-        body = json.dumps(batch).encode()
+        if WIRE == "binary":
+            body = bytes(clientmsg.pack_get_request(batch))
+            hdrs = {"Content-Type": clientmsg.CONTENT_TYPE,
+                    "Accept": clientmsg.CONTENT_TYPE}
+        else:
+            body = json.dumps(batch).encode()
+            hdrs = {"Content-Type": "application/json"}
         while not stop.is_set():
             tgt = rng.randrange(3)
             req = urllib.request.Request(
                 PEERS[tgt] + "/mraft/get_many", data=body,
-                method="POST",
-                headers={"Content-Type": "application/json"})
+                method="POST", headers=hdrs)
             try:
                 with urllib.request.urlopen(req, timeout=5) as r:
-                    out = json.loads(r.read())
+                    data = r.read()
+                    rtype = r.headers.get("Content-Type") or ""
+                if clientmsg.CONTENT_TYPE in rtype:
+                    vals, berrs = clientmsg.unpack_get_response(data)
+                    bn, bne = len(vals), len(berrs)
+                else:
+                    out = json.loads(data)
+                    bn, bne = out["n"], len(out["errs"])
                 with stats_lock:
-                    stats["burst_ok"] += out["n"] - len(out["errs"])
-                    stats["burst_err"] += len(out["errs"])
+                    stats["burst_ok"] += bn - bne
+                    stats["burst_err"] += bne
             except Exception:
                 with stats_lock:
                     stats["burst_err"] += 64
@@ -734,7 +769,9 @@ def nemesis_drill(cycles: int, smoke: bool, check: bool) -> None:
     print(f"NEMESIS SEED={seed}  (replay: python scripts/"
           f"chaos_drill.py --nemesis {cycles} --seed {seed}"
           f"{' --smoke' if smoke else ''}"
-          f"{' --check' if check else ''})", flush=True)
+          f"{' --check' if check else ''}"
+          f"{' --wire binary' if WIRE == 'binary' else ''})",
+          flush=True)
     print("NEMESIS PLAN: " + json.dumps(plan), flush=True)
     # replay determinism: the schedule is a pure function of the seed
     assert plan == plan_nemesis(seed, cycles, smoke)
@@ -1161,7 +1198,9 @@ def nemesis_drill(cycles: int, smoke: bool, check: bool) -> None:
         stop.set()
         print(f"NEMESIS GATE FAILURE — replay with: python "
               f"scripts/chaos_drill.py --nemesis {cycles} "
-              f"--seed {seed}", flush=True)
+              f"--seed {seed}"
+              f"{' --wire binary' if WIRE == 'binary' else ''}",
+              flush=True)
         harvest_flight("nemesis")
         raise
     finally:

@@ -69,10 +69,73 @@ from etcd_tpu.obs.metrics import (  # noqa: E402
     percentile_from_buckets,
 )
 from etcd_tpu.server.distserver import pack_requests  # noqa: E402
+from etcd_tpu.wire import clientmsg  # noqa: E402
 from etcd_tpu.wire.requests import Request  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 G = 64  # default; argv[4] overrides (G-scaling rows)
+
+
+# -- client wire (PR 14): HTTP+JSON vs the DCB1 binary framing --------------
+
+
+def _propose(c, body, wire):
+    """One propose_many POST; returns (n, n_errs).  ``wire=binary``
+    advertises the DCB1 reply framing (the request body is the
+    version-stable packed form either way)."""
+    hdrs = {"Content-Type": "application/octet-stream"}
+    if wire == "binary":
+        hdrs["Accept"] = clientmsg.CONTENT_TYPE
+    c.request("POST", "/mraft/propose_many", body=body, headers=hdrs)
+    resp = c.getresponse()
+    data = resp.read()
+    if clientmsg.CONTENT_TYPE in (resp.getheader("Content-Type")
+                                  or ""):
+        n, errs = clientmsg.unpack_propose_response(data)
+        return n, len(errs)
+    out = json.loads(data.decode())
+    return out["n"], len(out["errs"])
+
+
+def _get_many(c, paths, wire):
+    """One get_many POST; returns (n, n_errs).  ``wire=binary``
+    sends the DCB1 path frame AND accepts the binary reply."""
+    if wire == "binary":
+        body = bytes(clientmsg.pack_get_request(paths))
+        hdrs = {"Content-Type": clientmsg.CONTENT_TYPE,
+                "Accept": clientmsg.CONTENT_TYPE}
+    else:
+        body = json.dumps(paths).encode()
+        hdrs = {"Content-Type": "application/json"}
+    c.request("POST", "/mraft/get_many", body=body, headers=hdrs)
+    resp = c.getresponse()
+    data = resp.read()
+    if clientmsg.CONTENT_TYPE in (resp.getheader("Content-Type")
+                                  or ""):
+        vals, errs = clientmsg.unpack_get_response(data)
+        return len(vals), len(errs)
+    out = json.loads(data.decode())
+    return out["n"], len(out["errs"])
+
+
+def marshal_parse_shares(stages: dict) -> dict:
+    """The PR-14 stage-table evidence: what share of the cluster's
+    attributed stage CPU went to (un)marshal/parse work, total and
+    for the client wire alone (client.parse / client.marshal — the
+    only stages the --wire flag changes; peer frames are DGB3 in
+    both arms and the propose body's packed-Request parse is its own
+    dist.parse_batch stage because that form is version-stable on
+    every wire)."""
+    tot = sum(r["cpu_s"] for r in stages.values())
+    mp = sum(r["cpu_s"] for s, r in stages.items()
+             if "marshal" in s or "parse" in s)
+    cl = sum(r["cpu_s"] for s, r in stages.items()
+             if s.startswith("client."))
+    return {
+        "marshal_parse_cpu_share": round(mp / tot, 4) if tot else 0.0,
+        "client_wire_cpu_share": round(cl / tot, 4) if tot else 0.0,
+        "client_wire_cpu_s": round(cl, 3),
+    }
 
 
 def weighted_pct(pairs, q):
@@ -345,7 +408,8 @@ def wait_ready(proc, timeout=180):
 
 def run_once(total: int, conns: int, window: int,
              depth: int = 8, trace_sample: int | None = None,
-             flight_dir: str | None = None) -> dict:
+             flight_dir: str | None = None,
+             wire: str = "json") -> dict:
     import resource
 
     cpu0 = resource.getrusage(resource.RUSAGE_CHILDREN)
@@ -381,13 +445,9 @@ def run_once(total: int, conns: int, window: int,
                     for i in ids]
             body = pack_requests(reqs)
             bt0 = time.perf_counter()
-            c.request("POST", "/mraft/propose_many", body=body,
-                      headers={"Content-Type":
-                               "application/octet-stream"})
-            resp = c.getresponse()
-            out = json.loads(resp.read().decode())
+            n, nerr = _propose(c, body, wire)
             rtt = time.perf_counter() - bt0
-            ok = out["n"] - len(out["errs"])
+            ok = n - nerr
             if ok:
                 with lat_lock:
                     lats.append((rtt, ok))
@@ -415,11 +475,9 @@ def run_once(total: int, conns: int, window: int,
 
         # warmup: one small batch compiles the round path end to end
         warm = http.client.HTTPConnection(host, port, timeout=180)
-        warm.request("POST", "/mraft/propose_many",
-                     body=pack_requests([Request(
-                         method="PUT", id=(1 << 50) + 1,
-                         path="/warm/k", val="v")]))
-        warm.getresponse().read()
+        _propose(warm, pack_requests([Request(
+            method="PUT", id=(1 << 50) + 1,
+            path="/warm/k", val="v")]), wire)
         warm.close()
 
         t0 = time.perf_counter()
@@ -437,6 +495,7 @@ def run_once(total: int, conns: int, window: int,
         # the per-stage wall/CPU/device budget (PR 8): every row
         # carries WHERE the cluster's core went, not just the rates
         rtt["stage_seconds"] = fetch_stage_stats(urls)
+        rtt.update(marshal_parse_shares(rtt["stage_seconds"]))
         if trace_sample is not None:
             rtt["trace_sample"] = trace_sample
         if flight_dir:
@@ -445,7 +504,7 @@ def run_once(total: int, conns: int, window: int,
             rtt["snap_count"] = SNAP_COUNT
         row = {
             "hosts": 3, "groups": G, "conns": conns,
-            "window": window,
+            "window": window, "wire": wire,
             "pipeline_depth": depth,
             "lockstep_equivalent": depth == 1,
             # max client-side writes in flight: conns windows deep
@@ -497,13 +556,18 @@ def run_once(total: int, conns: int, window: int,
 def run_read_mix(total: int, conns: int, window: int,
                  mix: tuple[int, int] = (95, 5),
                  depth: int = 8,
-                 lease_ticks: int | None = None) -> dict:
+                 lease_ticks: int | None = None,
+                 wire: str = "json",
+                 val_bytes: int | None = None) -> dict:
     """Read-heavy row: reader connections free-run batched
     linearizable GETs while writer connections free-run batched PUTs
     for the SAME wall window — both rates come off one clock, so the
     reads/s : acked-writes/s ratio is the real relative capacity of
     the zero-WAL read lane vs the replicated write path under a
-    ``mix``-proportioned connection split."""
+    ``mix``-proportioned connection split.  ``val_bytes`` pads every
+    stored value to that size (None keeps the tiny legacy values) —
+    the wire compare runs at 1 KiB, a realistic config-blob size,
+    because a 4-byte value understates BOTH wires' marshal cost."""
     import resource
 
     cpu0 = resource.getrusage(resource.RUSAGE_CHILDREN)
@@ -530,20 +594,16 @@ def run_read_mix(total: int, conns: int, window: int,
             wait_ready(p)
         host, port = "127.0.0.1", ports[0]
 
-        def post(c, path, body):
-            c.request("POST", path, body=body,
-                      headers={"Content-Type":
-                               "application/octet-stream"})
-            return json.loads(c.getresponse().read().decode())
-
         # seed every key once so reads always resolve
+        seed_val = ("seed" if val_bytes is None
+                    else "s" * val_bytes)
         seed = http.client.HTTPConnection(host, port, timeout=180)
         for lo in range(0, n_keys, 256):
-            out = post(seed, "/mraft/propose_many", pack_requests([
+            _n, nerr = _propose(seed, pack_requests([
                 Request(method="PUT", id=(7 << 50) + lo + j + 1,
-                        path=k, val="seed")
-                for j, k in enumerate(keys[lo:lo + 256])]))
-            assert not out["errs"], out["errs"]
+                        path=k, val=seed_val)
+                for j, k in enumerate(keys[lo:lo + 256])]), wire)
+            assert nerr == 0, f"seed batch at {lo} had {nerr} errs"
         seed.close()
 
         lat_lock = threading.Lock()
@@ -569,8 +629,7 @@ def run_read_mix(total: int, conns: int, window: int,
                          for j in range(n)]
                 bt0 = time.perf_counter()
                 try:
-                    out = post(c, "/mraft/get_many",
-                               json.dumps(batch).encode())
+                    rn, rerr = _get_many(c, batch, wire)
                 except (OSError, http.client.HTTPException):
                     # reads are idempotent: reconnect and retry the
                     # batch (a reset under connection-storm load
@@ -580,12 +639,12 @@ def run_read_mix(total: int, conns: int, window: int,
                                                    timeout=120)
                     continue
                 rtt = time.perf_counter() - bt0
-                ok = out["n"] - len(out["errs"])
+                ok = rn - rerr
                 if ok:
                     with lat_lock:
                         r_lats.append((rtt, ok))
                 reads_done[t] += ok
-                read_errs[t] += len(out["errs"])
+                read_errs[t] += rerr
                 if ok == 0:
                     time.sleep(0.05)
                 sent += n
@@ -600,12 +659,14 @@ def run_read_mix(total: int, conns: int, window: int,
             while readers_live.is_set():
                 reqs = [Request(method="PUT", id=base + seq + j + 1,
                                 path=keys[(seq + j) % n_keys],
-                                val=f"w{seq + j}")
+                                val=(f"w{seq + j}" if val_bytes
+                                     is None else
+                                     f"w{seq + j}".ljust(val_bytes,
+                                                         "x")))
                         for j in range(w_window)]
                 seq += w_window
                 try:
-                    out = post(c, "/mraft/propose_many",
-                               pack_requests(reqs))
+                    wn, werr = _propose(c, pack_requests(reqs), wire)
                 except (OSError, http.client.HTTPException):
                     # a torn write batch's verdicts are unknowable:
                     # count NOTHING for it (never double-count) and
@@ -614,7 +675,7 @@ def run_read_mix(total: int, conns: int, window: int,
                     c = http.client.HTTPConnection(host, port,
                                                    timeout=120)
                     continue
-                writes_acked[t] += out["n"] - len(out["errs"])
+                writes_acked[t] += wn - werr
             c.close()
 
         t0 = time.perf_counter()
@@ -638,14 +699,16 @@ def run_read_mix(total: int, conns: int, window: int,
         stats = fetch_read_stats(urls)
         stats.update(disk_usage(tmp))
         stats["stage_seconds"] = fetch_stage_stats(urls)
+        stats.update(marshal_parse_shares(stats["stage_seconds"]))
         row = {
             "bench": "dist_read_mix",
-            "hosts": 3, "groups": G,
+            "hosts": 3, "groups": G, "wire": wire,
             "read_mix": f"{mix[0]}/{mix[1]}",
             "reader_conns": r_conns, "writer_conns": w_conns,
             "window": window, "writer_window": w_window,
             "pipeline_depth": depth,
             "lease_ticks": lease_ticks,
+            "val_bytes": val_bytes,
             "reads": reads, "read_errs": sum(read_errs),
             "writes_acked": writes,
             "reads_per_sec": round(reads / dt, 0),
@@ -795,6 +858,88 @@ def run_sweep(total: int, conns: int, window: int, *,
     return art
 
 
+def run_wire_compare(total: int, conns: int, window: int, *,
+                     mix: tuple[int, int] = (90, 10), depth: int,
+                     check: bool,
+                     out_dir: str | None = None) -> dict:
+    """The PR-14 wire gate: the SAME read-heavy load over HTTP+JSON
+    and over the DCB1 binary framing, on fresh clusters, with the
+    stage-table shares side by side.  The read-dominant mix is the
+    honest arena — get_many is where the JSON arm pays a dumps/loads
+    per value; the propose REQUEST body is the packed form in both
+    arms by design, so a write-only compare mostly measures peer
+    frames (identical DGB3 in both).  Values are 1 KiB (a realistic
+    config-blob size).  The binary advantage GROWS with value size:
+    at toy 4-byte values both wires are header-bound and near
+    parity, at 512B the binary arm is ~2x cheaper, at 1 KiB the
+    JSON arm's per-value dumps/loads dominates — the artifact
+    records val_bytes so the number is never quoted shapeless."""
+    rows = {}
+    for wire in ("json", "binary"):
+        row = run_read_mix(total, conns, window, mix=mix,
+                           depth=depth, wire=wire, val_bytes=1024)
+        print(json.dumps(row), flush=True)
+        rows[wire] = row
+    j, b = rows["json"], rows["binary"]
+    art = {
+        "bench": "dist_wire_compare",
+        "reads": total, "conns": conns, "window": window,
+        "read_mix": f"{mix[0]}/{mix[1]}",
+        "pipeline_depth": depth,
+        "val_bytes": 1024,
+        "rows": [j, b],
+        "json_client_wire_cpu_share": j["client_wire_cpu_share"],
+        "binary_client_wire_cpu_share": b["client_wire_cpu_share"],
+        "json_marshal_parse_cpu_share": j["marshal_parse_cpu_share"],
+        "binary_marshal_parse_cpu_share":
+            b["marshal_parse_cpu_share"],
+        "reads_per_sec_ratio": round(
+            b["reads_per_sec"] / max(1.0, j["reads_per_sec"]), 2),
+        "writes_acked_per_sec_ratio": round(
+            b["writes_acked_per_sec"]
+            / max(1.0, j["writes_acked_per_sec"]), 2),
+        # the PR-14 small-fix audit, so the artifact records WHAT
+        # changed under these shares, not just that they moved:
+        "alloc_hoists": {
+            "read_many": "before: one Chan + one ReadQueue "
+                         "registration allocated PER READ; after: "
+                         "one per GROUP (PendingRead.n folds the "
+                         "riders into one release sweep)",
+            "propose/store": "before: per-op dict row + payload "
+                             "re-fetch inside the store loops; "
+                             "after: row/b0/payload-table lookups "
+                             "hoisted batch-level, packed frames "
+                             "store via one flat nonzero scan",
+            "get_many serve": "before: per-read Event allocation; "
+                              "after: store.get_values one "
+                              "world-lock take per batch (PR 7) + "
+                              "batch GroupEntry marshal (PR 14)",
+        },
+    }
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        ts = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        path = os.path.join(out_dir, f"dist_wire_compare_{ts}.json")
+        with open(path, "w") as f:
+            json.dump(art, f, indent=1, sort_keys=True)
+        art["artifact"] = path
+    print(json.dumps({k: v for k, v in art.items() if k != "rows"}),
+          flush=True)
+    if check:
+        assert j["read_errs"] == 0 and b["read_errs"] == 0, \
+            (j["read_errs"], b["read_errs"])
+        # the acceptance gate: the binary arm spends less than half
+        # the JSON arm's share of serving-core CPU on the client
+        # wire stages (client.parse + client.marshal — the stages
+        # --wire changes; peer frames are DGB3 in both arms)
+        assert (b["client_wire_cpu_share"]
+                < 0.5 * j["client_wire_cpu_share"]), (
+            f"binary client-wire share "
+            f"{b['client_wire_cpu_share']} not < half of JSON's "
+            f"{j['client_wire_cpu_share']}")
+    return art
+
+
 def main() -> None:
     global G
     import argparse
@@ -822,6 +967,17 @@ def main() -> None:
                     help="measure acked/s with head-sampled tracing "
                          "on vs ETCD_TRACE_SAMPLE=0 (PR 8); with "
                          "--check asserts overhead <= 3%%")
+    ap.add_argument("--wire", choices=("json", "binary"),
+                    default="json",
+                    help="client batch framing (PR 14): HTTP+JSON "
+                         "or the DCB1 binary protocol (Accept-"
+                         "negotiated; requests upgrade too)")
+    ap.add_argument("--wire-compare", action="store_true",
+                    help="run the read-heavy load over BOTH wires "
+                         "on fresh clusters and emit the stage-"
+                         "share artifact; with --check asserts the "
+                         "binary arm's client-wire CPU share < "
+                         "half the JSON arm's")
     ap.add_argument("--trace-sample", type=int, default=64,
                     help="head-sampling rate for --trace-overhead's "
                          "traced run (1-in-N; default 64, the "
@@ -845,8 +1001,10 @@ def main() -> None:
         # small enough for CI: proves the pipelined path commits,
         # acks every proposal, and depth=1 still works (the
         # lockstep-equivalent window); the 4x gate needs the full
-        # sweep's sample sizes, not a smoke run
-        row = run_once(800, 4, 100, depth=1)
+        # sweep's sample sizes, not a smoke run.  --wire binary
+        # runs every leg over the DCB1 client framing (the
+        # scripts/test second leg).
+        row = run_once(800, 4, 100, depth=1, wire=args.wire)
         print(json.dumps(row), flush=True)
         assert row["acked"] == 800, row
         # the depth-8 leg doubles as the tracing acceptance run
@@ -859,7 +1017,7 @@ def main() -> None:
 
         with tempfile.TemporaryDirectory() as td:
             row = run_once(800, 4, 100, depth=8, trace_sample=4,
-                           flight_dir=td)
+                           flight_dir=td, wire=args.wire)
             print(json.dumps(row), flush=True)
             assert row["acked"] == 800, row
             assert row["stage_seconds"], \
@@ -873,18 +1031,25 @@ def main() -> None:
         # serve, off the zero-WAL lane, with reads outrunning the
         # concurrent writes; the 50x gate needs the full run's
         # sample sizes, not a smoke
-        row = run_read_mix(3000, 4, 100, mix=(90, 10))
+        row = run_read_mix(3000, 4, 100, mix=(90, 10),
+                           wire=args.wire)
         print(json.dumps(row), flush=True)
         assert row["reads"] == 3000 and row["read_errs"] == 0, row
         assert sum(row["read_serves_by_path"].values()) >= 3000, row
         assert row["reads_per_sec"] > row["writes_acked_per_sec"], \
             row
         return
+    if args.wire_compare:
+        run_wire_compare(args.total, args.conns, args.window,
+                         depth=args.depth, check=args.check,
+                         out_dir=args.out_dir)
+        return
     if args.read_mix:
         r, w = (int(x) for x in args.read_mix.split("/"))
         row = run_read_mix(args.total, args.conns, args.window,
                            mix=(r, w), depth=args.depth,
-                           lease_ticks=args.lease_ticks)
+                           lease_ticks=args.lease_ticks,
+                           wire=args.wire)
         print(json.dumps(row), flush=True)
         if args.out_dir:
             os.makedirs(args.out_dir, exist_ok=True)
@@ -913,7 +1078,8 @@ def main() -> None:
                   check=args.check, out_dir=args.out_dir)
         return
     print(json.dumps(run_once(args.total, args.conns, args.window,
-                              depth=args.depth)), flush=True)
+                              depth=args.depth, wire=args.wire)),
+          flush=True)
 
 
 if __name__ == "__main__":

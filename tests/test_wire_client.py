@@ -1,0 +1,240 @@
+"""Client batch wire protocol (PR 14): DCB1 frame codec fuzz +
+mixed-version negotiation.
+
+The binary client framing is opportunistic by contract: a
+binary-capable client against a JSON-only server (and the reverse)
+must complete every op over HTTP+JSON with zero failures, and the
+downgrade must be visible in ``etcd_client_wire_fallback_total`` —
+never silent, never an error."""
+
+import random
+import time
+
+import pytest
+
+from conftest import bootstrap_dist_leader, make_dist_cluster
+from etcd_tpu.obs import metrics as _obs
+from etcd_tpu.wire import clientmsg
+from etcd_tpu.wire.distmsg import FrameError
+
+_NEXT_ID = [1 << 20]
+
+
+def rid() -> int:
+    _NEXT_ID[0] += 1
+    return _NEXT_ID[0]
+
+
+# -- DCB1 codec ------------------------------------------------------------
+
+
+def _paths(rng):
+    n = rng.randrange(0, 6)
+    return [rng.choice(["/k", "/dir/leaf", "/uni/é中",
+                        "/" + "x" * rng.randrange(1, 40)])
+            for _ in range(n)]
+
+
+def _vals(rng):
+    n = rng.randrange(0, 6)
+    return [rng.choice([None, b"", b"v", rng.randbytes(100)])
+            for _ in range(n)]
+
+
+def _errs(rng, n):
+    if n == 0:
+        return {}
+    return {i: (rng.randrange(100, 500), rng.choice(["", "boom",
+                                                     "érr"]))
+            for i in rng.sample(range(n), rng.randrange(0, n + 1))}
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_clientmsg_roundtrip_fuzz(seed):
+    rng = random.Random(5000 + seed)
+    for _ in range(30):
+        paths = _paths(rng)
+        wire = bytes(clientmsg.pack_get_request(paths))
+        assert clientmsg.unpack_get_request(wire) == paths
+
+        vals = _vals(rng)
+        errs = _errs(rng, len(vals))
+        wire = bytes(clientmsg.pack_get_response(vals, errs))
+        bv, be = clientmsg.unpack_get_response(wire)
+        assert bv == vals and be == errs
+
+        n = rng.randrange(0, 600)
+        errs = _errs(rng, n)
+        wire = bytes(clientmsg.pack_propose_response(n, errs))
+        bn, be = clientmsg.unpack_propose_response(wire)
+        assert bn == n and be == errs
+        if not errs:
+            # the whole point of the sparse form: all-ok is tiny
+            assert len(wire) == 16
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_clientmsg_decoder_total_on_mutations(seed):
+    """Bit-flipped / truncated / extended client frames never escape
+    the codec as anything but FrameError (the client's negotiated
+    fallback and the server's 400 path both key on that type — an
+    untyped escape would kill a handler thread or the client)."""
+    rng = random.Random(6000 + seed)
+    for _ in range(30):
+        vals = _vals(rng)
+        frames = [
+            bytes(clientmsg.pack_get_request(_paths(rng))),
+            bytes(clientmsg.pack_get_response(
+                vals, _errs(rng, len(vals)))),
+            bytes(clientmsg.pack_propose_response(
+                rng.randrange(0, 50), _errs(rng, 10))),
+        ]
+        decoders = [clientmsg.unpack_get_request,
+                    clientmsg.unpack_get_response,
+                    clientmsg.unpack_propose_response]
+        for wire, dec in zip(frames, decoders):
+            wire = bytearray(wire)
+            op = rng.randrange(3)
+            if op == 0 and wire:
+                wire[rng.randrange(len(wire))] ^= \
+                    1 << rng.randrange(8)
+            elif op == 1 and wire:
+                del wire[rng.randrange(len(wire)):]
+            else:
+                wire += rng.randbytes(rng.randrange(1, 9))
+            try:
+                dec(bytes(wire))
+            except FrameError:
+                pass  # the one allowed failure mode
+
+
+# -- mixed-version negotiation over a live cluster -------------------------
+
+
+def _mk_cluster(tmp_path_factory, tag, monkey_env):
+    import os
+
+    old = os.environ.get("ETCD_WIRE_BINARY")
+    os.environ.update(monkey_env)
+    try:
+        servers, ports = make_dist_cluster(
+            tmp_path_factory.mktemp(tag), m=3, g=4)
+    finally:
+        if old is None:
+            os.environ.pop("ETCD_WIRE_BINARY", None)
+        else:
+            os.environ["ETCD_WIRE_BINARY"] = old
+    bootstrap_dist_leader(servers)
+    return servers, ports
+
+
+@pytest.fixture(scope="module")
+def bin_cluster(tmp_path_factory):
+    servers, ports = _mk_cluster(tmp_path_factory, "binwire", {})
+    yield servers, ports
+    for s in servers:
+        s.stop()
+
+
+@pytest.fixture(scope="module")
+def json_cluster(tmp_path_factory):
+    """A 'last release' server: speaks the batch endpoints but never
+    the binary reply framing (ETCD_WIRE_BINARY=0)."""
+    servers, ports = _mk_cluster(tmp_path_factory, "jsonwire",
+                                 {"ETCD_WIRE_BINARY": "0"})
+    assert not servers[0].wire_binary
+    yield servers, ports
+    for s in servers:
+        s.stop()
+
+
+def _counter(name, **labels):
+    return _obs.registry.counter(name, **labels).get()
+
+
+def _exercise(client, prefix):
+    """One propose_many + one get_many through ``client``; asserts
+    zero failed ops and value fidelity regardless of wire."""
+    from etcd_tpu.wire.requests import Request
+
+    keys = [f"{prefix}/k{i}" for i in range(8)]
+    reqs = [Request(method="PUT", id=rid(), path=k, val=f"v{i}")
+            for i, k in enumerate(keys)]
+    n, errs = client.propose_many(reqs, timeout=30.0)
+    assert n == len(keys) and errs == {}
+    vals, errs = client.get_many(keys, timeout=30.0)
+    assert errs == {}
+    assert vals == [f"v{i}" for i in range(len(keys))]
+    # and a miss comes back as a sparse error, not a failure
+    vals, errs = client.get_many([keys[0], f"{prefix}/absent"],
+                                 timeout=30.0)
+    assert vals[0] == "v0" and vals[1] is None
+    assert set(errs) == {1} and errs[1][0] == 100  # EcodeKeyNotFound
+
+
+def test_binary_negotiates_with_binary_server(bin_cluster):
+    from etcd_tpu.api.client import Client
+
+    _, ports = bin_cluster
+    c = Client([f"http://127.0.0.1:{ports[0]}"], timeout=30.0)
+    b0 = _counter("etcd_client_wire_requests_total", wire="binary")
+    _exercise(c, "/neg/bin")
+    assert c._wire == "binary"
+    assert _counter("etcd_client_wire_requests_total",
+                    wire="binary") - b0 >= 3
+
+
+def test_binary_client_falls_back_on_json_server(json_cluster):
+    """Forward compat: new client, old server.  Every op completes
+    over JSON; the downgrade is counted, not raised."""
+    from etcd_tpu.api.client import Client
+
+    _, ports = json_cluster
+    c = Client([f"http://127.0.0.1:{ports[0]}"], timeout=30.0)
+    f0 = _counter("etcd_client_wire_fallback_total",
+                  reason="not_negotiated")
+    j0 = _counter("etcd_client_wire_requests_total", wire="json")
+    _exercise(c, "/neg/fallback")
+    assert c._wire == "json"  # sticky: stops advertising
+    assert _counter("etcd_client_wire_fallback_total",
+                    reason="not_negotiated") - f0 == 1
+    assert _counter("etcd_client_wire_requests_total",
+                    wire="json") - j0 >= 3
+
+
+def test_json_client_against_binary_server(bin_cluster):
+    """Backward compat: old client, new server.  No Accept header is
+    ever sent, so the server answers plain JSON and nothing falls
+    back (there was never a negotiation to lose)."""
+    from etcd_tpu.api.client import Client
+
+    _, ports = bin_cluster
+    c = Client([f"http://127.0.0.1:{ports[0]}"], timeout=30.0,
+               wire="json")
+    f0 = _counter("etcd_client_wire_fallback_total",
+                  reason="not_negotiated")
+    _exercise(c, "/neg/json")
+    assert c._wire == "json"
+    assert _counter("etcd_client_wire_fallback_total",
+                    reason="not_negotiated") - f0 == 0
+
+
+def test_binary_get_many_request_body_upgrade(bin_cluster):
+    """After negotiation the get_many REQUEST body itself rides the
+    DCB1 frame (the propose body stays the version-stable packed
+    form by design — replies alone are negotiated there)."""
+    from etcd_tpu.api.client import Client
+    from etcd_tpu.wire.requests import Request
+
+    servers, ports = bin_cluster
+    c = Client([f"http://127.0.0.1:{ports[0]}"], timeout=30.0)
+    key = "/neg/upg/k"
+    n, errs = c.propose_many(
+        [Request(method="PUT", id=rid(), path=key, val="up")],
+        timeout=30.0)
+    assert (n, errs) == (1, {})
+    assert c._wire == "binary"  # first reply negotiated it
+    # this request is packed client-side as DCB1 (covered by the
+    # server's magic sniff) and still reads the committed value
+    vals, errs = c.get_many([key], timeout=30.0)
+    assert (vals, errs) == (["up"], {})

@@ -104,6 +104,8 @@ def _dist_cases(rng):
         VoteResp,
     )
 
+    from etcd_tpu.wire.distmsg import PackedPayloads, flat_entry_table
+
     g = rng.choice([1, 3, 8])
     e = rng.choice([1, 2, 5])
     i32 = lambda lo=0, hi=1 << 20: np.asarray(  # noqa: E731
@@ -112,6 +114,7 @@ def _dist_cases(rng):
         [rng.random() < 0.5 for _ in range(g)], bool)
     seq = rng.randrange(1 << 31)
     epoch = rng.randrange(1 << 31)
+    prev_idx = i32()
     n_ents = np.asarray([rng.randrange(e + 1) for _ in range(g)],
                         np.int32)
     payloads = [[_bytes(rng) for _ in range(int(n))] for n in n_ents]
@@ -122,14 +125,27 @@ def _dist_cases(rng):
         trace = [(rng.randrange(g), rng.randrange(1 << 20),
                   rng.randrange(1 << 32), rng.randrange(8))
                  for _ in range(rng.randrange(1, 4))]
+    # optional packed multi-group table (PR 14): the DGB3 trailing
+    # section; the table is fully determined by (prev_idx, n_ents),
+    # so valid frames can only carry the canonical one.  Half the
+    # packed cases hand marshal the flat PackedPayloads form (the
+    # serving-loop fast path); the rest nested lists.
+    ent_group = ent_gindex = None
+    pays = payloads
+    if rng.random() < 0.5:
+        ent_group, ent_gindex = flat_entry_table(prev_idx, n_ents)
+        if rng.random() < 0.5:
+            pays = PackedPayloads.from_counts(
+                [b for grp in payloads for b in grp], n_ents)
     yield AppendBatch(
-        sender=rng.randrange(4), term=i32(), prev_idx=i32(),
+        sender=rng.randrange(4), term=i32(), prev_idx=prev_idx,
         prev_term=i32(), n_ents=n_ents, commit=i32(), active=mask(),
         need_snap=mask(),
         ent_terms=np.asarray(
             [[rng.randrange(1 << 20) for _ in range(e)]
              for _ in range(g)], np.int32),
-        payloads=payloads, seq=seq, epoch=epoch, trace=trace)
+        payloads=pays, seq=seq, epoch=epoch, trace=trace,
+        ent_group=ent_group, ent_gindex=ent_gindex)
     yield AppendResp(sender=rng.randrange(4), term=i32(), ok=mask(),
                      acked=i32(), hint=i32(), active=mask(),
                      seq=seq, epoch=epoch)
@@ -208,6 +224,45 @@ def test_dist_negative_lane_count_rejected_fast():
     with pytest.raises(FrameError):
         unmarshal_any(bytes(wire))
     assert time.perf_counter() - t0 < 1.0  # fails fast, no spin
+
+
+def test_dist_packed_table_validated_against_sections():
+    """The DGB3 packed table is redundant with the [G] sections by
+    construction, so the decoder recomputes it and demands exact
+    agreement: a corrupt table that keeps the flag + count intact
+    must fail as FrameError, never reach the serving loop's
+    fancy-indexing with out-of-contract (group, gindex) pairs."""
+    import struct
+
+    import numpy as np
+
+    from etcd_tpu.wire.distmsg import (
+        AppendBatch,
+        FrameError,
+        flat_entry_table,
+        unmarshal_any,
+    )
+
+    g = 2
+    prev_idx = np.asarray([4, 7], np.int32)
+    n_ents = np.asarray([2, 1], np.int32)
+    eg, ei = flat_entry_table(prev_idx, n_ents)
+    frame = AppendBatch(
+        sender=0, term=np.ones(g, np.int32), prev_idx=prev_idx,
+        prev_term=np.zeros(g, np.int32), n_ents=n_ents,
+        commit=np.zeros(g, np.int32), active=np.ones(g, bool),
+        need_snap=np.zeros(g, bool),
+        ent_terms=np.ones((g, 2), np.int32),
+        payloads=[[b"a", b"bb"], [b"ccc"]],
+        ent_group=eg, ent_gindex=ei)
+    wire = bytearray(frame.marshal())
+    back = unmarshal_any(bytes(wire))  # sanity: valid as built
+    assert back.ent_gindex is not None
+    # the packed table is the trailing section; its last 4 bytes are
+    # the final gindex entry — point it outside the lane's window
+    struct.pack_into("<i", wire, len(wire) - 4, 99)
+    with pytest.raises(FrameError):
+        unmarshal_any(bytes(wire))
 
 
 @pytest.mark.parametrize("seed", range(10))
