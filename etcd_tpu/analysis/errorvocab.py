@@ -41,6 +41,10 @@ _ALLOWED = {
     # the injected-loss control exception the peer handler turns
     # into a closed connection
     "EtcdNoSpace", "FrameDropped",
+    # PR 15: EtcdOverCapacity carries ECODE_OVER_CAPACITY (same
+    # vocabulary-subclass pattern as EtcdNoSpace) — the ingest
+    # role raises it when a shard lane sheds
+    "EtcdOverCapacity",
     # stdlib
     "ValueError", "TypeError", "KeyError", "IndexError",
     "AttributeError", "RuntimeError", "TimeoutError",

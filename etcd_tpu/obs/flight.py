@@ -74,9 +74,16 @@ class FlightRecorder:
     def __init__(self, node: str = "", slot: int = -1,
                  capacity: int | None = None,
                  sample: int | None = None,
-                 registry: Registry | None = None):
+                 registry: Registry | None = None,
+                 role: str = "server"):
         self.node = node
         self.slot = slot
+        # role-split topology (PR 15): each role process is its own
+        # incarnation — the stitcher keys incarnations on
+        # (slot, role) so an ingest restart never shadows the shard
+        # dumps of the same slot.  Single-process servers keep the
+        # default and stitch exactly as before.
+        self.role = role
         self.capacity = (capacity if capacity is not None
                          else _env_int("ETCD_FLIGHT_RING",
                                        DEFAULT_CAPACITY))
@@ -170,6 +177,7 @@ class FlightRecorder:
             pass
         return {
             "node": self.node, "slot": self.slot, "pid": os.getpid(),
+            "role": self.role,
             "wall_anchor": time.time(),
             "mono_anchor": time.monotonic(),
             "capacity": self.capacity, "sample_n": self.sample_n,
@@ -219,7 +227,17 @@ def harvest_rings(urls: list[str], out_dir: str,
             log.warning("flight harvest: %s unreachable (%s)", u,
                         type(e).__name__)
             continue
-        p = os.path.join(out_dir, f"flight_s{i}.json")
+        # name by (slot, role) when the dump says so: a role-split
+        # host contributes several rings per slot and they must not
+        # clobber one another on disk
+        tag = f"s{i}"
+        try:
+            d = json.loads(body)
+            if d.get("role", "server") != "server":
+                tag = f"s{d.get('slot', i)}_{d['role']}"
+        except (ValueError, KeyError, TypeError):
+            pass
+        p = os.path.join(out_dir, f"flight_{tag}.json")
         with open(p, "wb") as f:
             f.write(body)
         paths.append(p)

@@ -1,0 +1,212 @@
+"""Single-producer single-consumer byte ring over POSIX shared
+memory — the committed-stream handoff between a serving shard and
+the apply/watch worker (server/roles.py).
+
+Design constraints, in order:
+
+  * **Bounded by construction.**  The ring is a fixed byte span; a
+    producer that outruns the consumer drops whole records and
+    counts them (``dropped``), it never blocks the raft apply path
+    and never grows.  Consumers detect the loss as a gap in the
+    COMMIT frame ``seq`` (wire/rolemsg.py) rather than silently
+    missing events.
+  * **Restart without replay.**  Both cursors live in the shared
+    header, so a crashed consumer re-attaches and resumes at its own
+    persisted ``tail`` — records applied before the crash are behind
+    the cursor and can never be consumed twice (the no-double-apply
+    property tests/test_roles.py exercises).
+  * **Zero-copy handoff.**  Records are length-prefixed and never
+    split across the wrap, so a reader can hand a contiguous
+    ``memoryview`` straight to ``frombuffer`` parsers.  ``pop``
+    copies by default because the payload outlives the cursor
+    advance; ``peek``/``advance`` expose the no-copy path.
+
+Layout: 64-byte header | capacity bytes of records.
+
+  header: magic "SRG1" u32 | generation u32 | head u64 | tail u64 |
+          dropped u64 | capacity u64 | reserved
+
+  record: length u32 | payload (contiguous).  A record that would
+  straddle the end of the span is preceded by a wrap marker
+  (0xFFFFFFFF, written only when >= 4 bytes remain before the
+  boundary) and starts at offset 0.
+
+Cursors are monotonic byte offsets (masked modulo capacity on use),
+stored as single aligned 8-byte little-endian writes — atomic for
+in-order stores on the platforms we run (CPython under the GIL emits
+one memcpy per struct.pack_into).  The producer publishes ``head``
+only after the payload bytes are fully written; the consumer
+publishes ``tail`` only after it has finished (or copied) the
+payload.
+"""
+
+from __future__ import annotations
+
+import struct
+from multiprocessing import shared_memory
+
+_MAGIC = 0x31475253  # "SRG1" little-endian
+_WRAP = 0xFFFFFFFF
+_HDR_SIZE = 64
+_OFF_MAGIC = 0
+_OFF_GEN = 4
+_OFF_HEAD = 8
+_OFF_TAIL = 16
+_OFF_DROPPED = 24
+_OFF_CAP = 32
+
+#: Smallest record span: u32 length prefix. Also the wrap marker size.
+_LEN = 4
+
+
+class ShmRing:
+    """One endpoint of the ring. The creator (role supervisor) owns
+    the segment lifetime; producers/consumers attach by name."""
+
+    def __init__(self, name: str, capacity: int = 1 << 20, *,
+                 create: bool = False):
+        if capacity <= 2 * _LEN:
+            raise ValueError("capacity too small")
+        self.name = name
+        if create:
+            self._shm = shared_memory.SharedMemory(
+                name=name, create=True, size=_HDR_SIZE + capacity)
+            buf = self._shm.buf
+            buf[:_HDR_SIZE] = b"\x00" * _HDR_SIZE
+            struct.pack_into("<I", buf, _OFF_MAGIC, _MAGIC)
+            struct.pack_into("<Q", buf, _OFF_CAP, capacity)
+        else:
+            self._shm = shared_memory.SharedMemory(name=name)
+        buf = self._shm.buf
+        (magic,) = struct.unpack_from("<I", buf, _OFF_MAGIC)
+        if magic != _MAGIC:
+            raise ValueError(f"shm segment {name!r} is not a ring")
+        (self.capacity,) = struct.unpack_from("<Q", buf, _OFF_CAP)
+        self._buf = buf
+        self._owner = create
+
+    # -- header accessors ---------------------------------------------------
+
+    def _get(self, off: int) -> int:
+        (v,) = struct.unpack_from("<Q", self._buf, off)
+        return v
+
+    def _put(self, off: int, v: int) -> None:
+        struct.pack_into("<Q", self._buf, off, v)
+
+    @property
+    def head(self) -> int:
+        return self._get(_OFF_HEAD)
+
+    @property
+    def tail(self) -> int:
+        return self._get(_OFF_TAIL)
+
+    @property
+    def dropped(self) -> int:
+        return self._get(_OFF_DROPPED)
+
+    def bump_generation(self) -> int:
+        """Producer calls on (re-)attach so observers can tell a
+        restarted shard from a stalled one."""
+        (g,) = struct.unpack_from("<I", self._buf, _OFF_GEN)
+        struct.pack_into("<I", self._buf, _OFF_GEN, (g + 1) & 0xFFFFFFFF)
+        return g + 1
+
+    @property
+    def generation(self) -> int:
+        (g,) = struct.unpack_from("<I", self._buf, _OFF_GEN)
+        return g
+
+    def __len__(self) -> int:
+        return self.head - self.tail
+
+    # -- producer -----------------------------------------------------------
+
+    def push(self, payload) -> bool:
+        """Appends one record; returns False (and counts a drop) if
+        it doesn't fit. Records larger than capacity - 2*_LEN - 1
+        can never fit and always drop."""
+        n = len(payload)
+        head, tail = self.head, self.tail
+        cap = self.capacity
+        pos = head % cap
+        to_end = cap - pos
+        need = _LEN + n
+        if to_end < need:
+            # wrap: burn the rest of the span (+ marker if room)
+            need = to_end + _LEN + n
+            marker = to_end >= _LEN
+        else:
+            marker = False
+        # full-ring guard: leave one byte free so head==tail is
+        # unambiguously "empty"
+        if need >= cap - (head - tail):
+            self._put(_OFF_DROPPED, self.dropped + 1)
+            return False
+        buf = self._buf
+        if to_end < _LEN + n:
+            if marker:
+                struct.pack_into("<I", buf, _HDR_SIZE + pos, _WRAP)
+            pos = 0
+        struct.pack_into("<I", buf, _HDR_SIZE + pos, n)
+        buf[_HDR_SIZE + pos + _LEN:_HDR_SIZE + pos + _LEN + n] = payload
+        # publish only after the payload bytes are in place
+        self._put(_OFF_HEAD, head + need)
+        return True
+
+    # -- consumer -----------------------------------------------------------
+
+    def _peek(self) -> tuple[memoryview, int] | None:
+        """Returns (payload view, consumed byte span) or None."""
+        head, tail = self.head, self.tail
+        if head == tail:
+            return None
+        cap = self.capacity
+        pos = tail % cap
+        to_end = cap - pos
+        skipped = 0
+        if to_end < _LEN:
+            # producer wrapped without room for a marker
+            skipped = to_end
+            pos = 0
+        else:
+            (n,) = struct.unpack_from("<I", self._buf,
+                                      _HDR_SIZE + pos)
+            if n == _WRAP:
+                skipped = to_end
+                pos = 0
+            elif _LEN + n > to_end:
+                # length prefix would run past the span boundary:
+                # corrupt header, resync at the producer cursor
+                self._put(_OFF_TAIL, head)
+                return None
+        (n,) = struct.unpack_from("<I", self._buf, _HDR_SIZE + pos)
+        if _LEN + n > cap - pos or n == _WRAP:
+            self._put(_OFF_TAIL, head)
+            return None
+        view = self._buf[_HDR_SIZE + pos + _LEN:
+                         _HDR_SIZE + pos + _LEN + n]
+        return view, skipped + _LEN + n
+
+    def pop(self) -> bytes | None:
+        """Copies out the next record and advances, or None if
+        empty."""
+        got = self._peek()
+        if got is None:
+            return None
+        view, span = got
+        payload = bytes(view)
+        view.release()
+        self._put(_OFF_TAIL, self.tail + span)
+        return payload
+
+    def close(self) -> None:
+        self._buf = None
+        self._shm.close()
+
+    def unlink(self) -> None:
+        try:
+            self._shm.unlink()
+        except FileNotFoundError:
+            pass

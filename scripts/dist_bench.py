@@ -399,7 +399,11 @@ def wait_ready(proc, timeout=180):
     t0 = time.time()
     while time.time() - t0 < timeout:
         line = proc.stdout.readline()
-        if "READY" in line:
+        # exact match: role-split children print "ROLE-READY <role>"
+        # on the inherited stdout before the supervisor's cluster-
+        # wide "READY" — a substring match would return while the
+        # shards are still coming up
+        if line.strip() == "READY":
             return
         if proc.poll() is not None:
             raise AssertionError(f"node died rc={proc.returncode}")
@@ -940,6 +944,298 @@ def run_wire_compare(total: int, conns: int, window: int, *,
     return art
 
 
+def free_port_block(span):
+    """A base port ``p`` with ``p..p+span-1`` all bind-free — the
+    role topology derives every role's port from its host's base
+    (shard s peers on base + m*s, the worker on client + m), so the
+    whole block must be clear, not just the base."""
+    for _ in range(64):
+        socks = []
+        try:
+            s0 = socket.socket()
+            s0.bind(("127.0.0.1", 0))
+            base = s0.getsockname()[1]
+            if base + span >= 65535:
+                continue
+            socks.append(s0)
+            ok = True
+            for off in range(1, span):
+                s = socket.socket()
+                try:
+                    s.bind(("127.0.0.1", base + off))
+                    socks.append(s)
+                except OSError:
+                    ok = False
+                    break
+            if ok:
+                return base
+        finally:
+            for s in socks:
+                s.close()
+    raise AssertionError("no free port block of span %d" % span)
+
+
+def spawn_roles(tmp, slot, urls, client_port, shards, depth=8):
+    """One host of the role-split topology (PR 15): dist_node
+    --roles delegates to the roles supervisor — ingest on
+    ``client_port``, apply/watch worker on ``client_port + m``,
+    ``shards`` serving shards peering on ``peer + m*s``."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    cmd = [sys.executable,
+           os.path.join(REPO, "scripts", "dist_node.py"),
+           "--data-dir", os.path.join(tmp, f"d{slot}"),
+           "--slot", str(slot), "--peers", ",".join(urls),
+           "--groups", str(G), "--cap", str(CAP),
+           "--max-batch-ents", "128",
+           "--pipeline-depth", str(depth),
+           "--roles", str(shards),
+           "--client-port", str(client_port)]
+    if SNAP_COUNT:
+        cmd += ["--snap-count", str(SNAP_COUNT)]
+    if slot == 0:
+        cmd.append("--bootstrap")
+    return subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, env=env,
+                            text=True)
+
+
+def role_obs_urls(peer_base, client_base, m, shards):
+    """Every role process's obs endpoint, labeled by role name:
+    stage tables are per-process registries, so the bench must pull
+    each role's own table to attribute CPU per role."""
+    out = {}
+    out["ingest"] = [f"http://127.0.0.1:{client_base + i}"
+                     for i in range(m)]
+    out["worker"] = [f"http://127.0.0.1:{client_base + i + m}"
+                     for i in range(m)]
+    for s in range(shards):
+        out[f"shard{s}"] = [
+            f"http://127.0.0.1:{peer_base + i + m * s}"
+            for i in range(m)]
+    return out
+
+
+def run_roles_once(total: int, conns: int, window: int,
+                   shards: int, depth: int = 8) -> dict:
+    """One write-bench run over the role-split topology: 3 hosts,
+    each a supervised family of (ingest + worker + ``shards``
+    serving shards); the load targets host 0's INGEST port, which
+    coalesces into packed DRH1 handoff frames to its local shard
+    leaders.  The row carries the merged stage table plus the
+    per-role CPU split the compare gate reads."""
+    import resource
+
+    assert G % shards == 0, (G, shards)
+    cpu0 = resource.getrusage(resource.RUSAGE_CHILDREN)
+    m = 3
+    peer_base = free_port_block(m * shards)
+    client_base = free_port_block(2 * m)
+    urls = [f"http://127.0.0.1:{peer_base + i}" for i in range(m)]
+    tmp = tempfile.mkdtemp()
+    procs = [spawn_roles(tmp, s, urls, client_base + s, shards,
+                         depth=depth) for s in range(m)]
+    acked = [0] * conns
+    try:
+        for p in procs:
+            wait_ready(p)
+        host, port = "127.0.0.1", client_base
+
+        lat_lock = threading.Lock()
+        lats: list[tuple[float, int]] = []
+        ns = 8 * G
+
+        def batch(c, t, lo, n):
+            ids = [(t << 40) | (lo + j + 1) for j in range(n)]
+            reqs = [Request(method="PUT", id=i,
+                            path=f"/b{i % ns}/k{i & 0xFFFF}",
+                            val="v")
+                    for i in ids]
+            body = pack_requests(reqs)
+            bt0 = time.perf_counter()
+            n, nerr = _propose(c, body, "binary")
+            rtt = time.perf_counter() - bt0
+            ok = n - nerr
+            if ok:
+                with lat_lock:
+                    lats.append((rtt, ok))
+            return ok
+
+        per = [total // conns + (1 if t < total % conns else 0)
+               for t in range(conns)]
+
+        def client(t):
+            c = http.client.HTTPConnection(host, port, timeout=120)
+            sent = 0
+            while sent < per[t]:
+                n = min(window, per[t] - sent)
+                done_now = batch(c, t, sent, n)
+                if done_now == 0:
+                    time.sleep(0.05)
+                acked[t] += done_now
+                sent += n
+            c.close()
+
+        warm = http.client.HTTPConnection(host, port, timeout=180)
+        _propose(warm, pack_requests([Request(
+            method="PUT", id=(1 << 50) + 1,
+            path="/warm/k", val="v")]), "binary")
+        warm.close()
+
+        t0 = time.perf_counter()
+        ts = [threading.Thread(target=client, args=(t,))
+              for t in range(conns)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        dt = time.perf_counter() - t0
+        done = sum(acked)
+
+        by_role = role_obs_urls(peer_base, client_base, m, shards)
+        per_role_cpu = {}
+        merged: dict[str, dict[str, float]] = {}
+        for role, rurls in by_role.items():
+            st = fetch_stage_stats(rurls)
+            per_role_cpu[role] = round(
+                sum(r["cpu_s"] for r in st.values()), 3)
+            for stage, r in st.items():
+                agg = merged.setdefault(
+                    stage, {"wall_s": 0.0, "cpu_s": 0.0,
+                            "device_s": 0.0, "passes": 0})
+                for k in ("wall_s", "cpu_s", "device_s", "passes"):
+                    agg[k] += r[k]
+        tot_cpu = sum(r["cpu_s"] for r in merged.values())
+        handoff = sum(r["cpu_s"] for s, r in merged.items()
+                      if s.startswith("role.handoff_"))
+        row = {
+            "hosts": m, "groups": G, "conns": conns,
+            "window": window, "serving_shards": shards,
+            "pipeline_depth": depth,
+            "host_cores": os.cpu_count(),
+            "key_scheme": "hashed-spread", "namespaces": ns,
+            "backend": f"3 supervised role families x "
+                       f"(ingest + worker + {shards} shards)",
+            "acked": done,
+            "proposals_per_sec": round(done / dt, 0),
+            "ack_p50_ms": round(weighted_pct(lats, 0.5) * 1e3, 1),
+            "ack_p99_ms": round(weighted_pct(lats, 0.99) * 1e3, 1),
+            "wall_s": round(dt, 2),
+            "per_role_cpu_s": per_role_cpu,
+            "stage_seconds": {
+                s: {k: (round(v, 3) if k != "passes" else int(v))
+                    for k, v in r.items()}
+                for s, r in sorted(merged.items(),
+                                   key=lambda kv: -kv[1]["cpu_s"])},
+            "handoff_cpu_s": round(handoff, 3),
+            "handoff_cpu_share": (round(handoff / tot_cpu, 4)
+                                  if tot_cpu else 0.0),
+        }
+        return row
+    finally:
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+        try:
+            cpu1 = resource.getrusage(resource.RUSAGE_CHILDREN)
+            row["cluster_cpu_s"] = round(
+                cpu1.ru_utime + cpu1.ru_stime
+                - cpu0.ru_utime - cpu0.ru_stime, 2)
+            row["cluster_cpu_ms_per_acked"] = round(
+                1e3 * row["cluster_cpu_s"] / max(1, row["acked"]), 3)
+        except NameError:
+            pass
+
+
+# the PR 14 wire-compare JSON arm's client-wire CPU share — the
+# serving-core cost the packed role handoff replaces; the roles gate
+# holds role.handoff_* strictly under it
+JSON_CLIENT_WIRE_SHARE = 0.084
+
+
+def run_roles_compare(total: int, conns: int, window: int, *,
+                      depth: int, check: bool,
+                      out_dir: str | None = None) -> dict:
+    """The PR-15 role-scaling gate: the SAME write load against 1
+    and 4 serving shards per host, fresh clusters.  The artifact
+    records the host's core count because the scaling conclusion is
+    conditional: on a multi-core host the 4-shard family must fully
+    ack and run >= 3x the 1-shard family; on fewer cores the shards
+    time-share one core, so only the 1-shard full-ack and the
+    handoff-share gates assert and the wide row is recorded."""
+    rows = {}
+    for shards in (1, 4):
+        row = run_roles_once(total, conns, window, shards,
+                             depth=depth)
+        print(json.dumps(row), flush=True)
+        rows[shards] = row
+    r1, r4 = rows[1], rows[4]
+    cores = os.cpu_count() or 1
+    art = {
+        "bench": "dist_roles_compare",
+        "writes": total, "conns": conns, "window": window,
+        "pipeline_depth": depth,
+        "host_cores": cores,
+        "rows": [r1, r4],
+        "per_role_cpu_s_1": r1["per_role_cpu_s"],
+        "per_role_cpu_s_4": r4["per_role_cpu_s"],
+        "handoff_cpu_share_1": r1["handoff_cpu_share"],
+        "handoff_cpu_share_4": r4["handoff_cpu_share"],
+        "json_client_wire_share_replaced": JSON_CLIENT_WIRE_SHARE,
+        "acked_per_sec_multiple_1_to_4": round(
+            r4["proposals_per_sec"]
+            / max(1.0, r1["proposals_per_sec"]), 2),
+        "scaling_gate_applies": cores >= 4,
+    }
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        ts = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        path = os.path.join(out_dir, f"dist_roles_compare_{ts}.json")
+        with open(path, "w") as f:
+            json.dump(art, f, indent=1, sort_keys=True)
+        art["artifact"] = path
+    print(json.dumps({k: v for k, v in art.items() if k != "rows"}),
+          flush=True)
+    if check:
+        assert r1["acked"] == total, r1["acked"]
+        for r in (r1, r4):
+            assert r["handoff_cpu_share"] < JSON_CLIENT_WIRE_SHARE, (
+                f"role handoff share {r['handoff_cpu_share']} not "
+                f"below the JSON client-wire share "
+                f"{JSON_CLIENT_WIRE_SHARE} it replaced")
+        if cores >= 4:
+            # both legs of the comparison are meaningful: full acks
+            # on the wide row, then the scaling multiple itself
+            assert r4["acked"] == total, r4["acked"]
+            assert art["acked_per_sec_multiple_1_to_4"] >= 3.0, (
+                f"acked/s multiple "
+                f"{art['acked_per_sec_multiple_1_to_4']} < 3.0 on a "
+                f"{cores}-core host")
+        else:
+            # undersized host: 4 shards/host means 12 consensus
+            # planes time-sharing the same core(s), so the wide row
+            # can miss acks on pure capacity grounds — record it
+            # (artifact keeps both rows) without asserting; the
+            # correctness gate for role mode lives in
+            # `--roles N --check` and the role_kill nemesis
+            print(json.dumps({
+                "note": f"{cores}-core host: shards time-share one "
+                        f"core, the full-ack + >=3x scaling gates "
+                        f"on the 4-shard row need >=4 cores and "
+                        f"were recorded, not asserted"}),
+                flush=True)
+    return art
+
+
 def main() -> None:
     global G
     import argparse
@@ -978,6 +1274,20 @@ def main() -> None:
                          "share artifact; with --check asserts the "
                          "binary arm's client-wire CPU share < "
                          "half the JSON arm's")
+    ap.add_argument("--roles", type=int, default=0, metavar="S",
+                    help="run the write bench over the role-split "
+                         "topology (PR 15): each host is a "
+                         "supervised ingest + apply/watch worker + "
+                         "S serving shards; with --check asserts "
+                         "full acks and the handoff-share gate")
+    ap.add_argument("--roles-compare", action="store_true",
+                    help="run the SAME write load against 1 and 4 "
+                         "serving shards per host and emit the "
+                         "scaling artifact (host core count, "
+                         "per-role CPU seconds, 1->4 acked/s "
+                         "multiple); with --check asserts the "
+                         ">=3x gate on >=4-core hosts and the "
+                         "handoff-share gate everywhere")
     ap.add_argument("--trace-sample", type=int, default=64,
                     help="head-sampling rate for --trace-overhead's "
                          "traced run (1-in-N; default 64, the "
@@ -1038,6 +1348,28 @@ def main() -> None:
         assert sum(row["read_serves_by_path"].values()) >= 3000, row
         assert row["reads_per_sec"] > row["writes_acked_per_sec"], \
             row
+        return
+    if args.roles_compare:
+        run_roles_compare(args.total, args.conns, args.window,
+                          depth=args.depth, check=args.check,
+                          out_dir=args.out_dir)
+        return
+    if args.roles:
+        row = run_roles_once(args.total, args.conns, args.window,
+                             args.roles, depth=args.depth)
+        print(json.dumps(row), flush=True)
+        if args.out_dir:
+            os.makedirs(args.out_dir, exist_ok=True)
+            ts = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+            with open(os.path.join(
+                    args.out_dir,
+                    f"dist_roles_{ts}.json"), "w") as f:
+                json.dump(row, f, indent=1, sort_keys=True)
+        if args.check:
+            assert row["acked"] == args.total, row["acked"]
+            assert (row["handoff_cpu_share"]
+                    < JSON_CLIENT_WIRE_SHARE), \
+                row["handoff_cpu_share"]
         return
     if args.wire_compare:
         run_wire_compare(args.total, args.conns, args.window,

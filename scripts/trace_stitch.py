@@ -75,52 +75,72 @@ def load_dumps(paths: list[str]) -> list[dict]:
     return nodes
 
 
-def _frame_quads(nodes: list[dict]) -> dict[tuple[int, int], list]:
-    """(sender_slot, receiver_slot) -> [(t_send, t_recv, t_resp,
-    t_ack), ...] joined on the frame's per-channel seq."""
+def _nid(n: dict) -> tuple[int, str]:
+    """Process identity for stitching: (slot, role).  Role-split
+    hosts (PR 15) contribute several rings per slot — ingest,
+    apply worker, one per serving shard — each its own incarnation
+    with its own clock base and seq counters.  Single-process dumps
+    carry the default role and collapse to plain per-slot identity."""
+    return (n["slot"], n.get("role", "server"))
+
+
+def _nid_s(nid: tuple[int, str]) -> str:
+    slot, role = nid
+    return str(slot) if role == "server" else f"{slot}/{role}"
+
+
+def _frame_quads(nodes: list[dict]) -> dict[tuple, list]:
+    """(sender_nid, receiver_nid) -> [(t_send, t_recv, t_resp,
+    t_ack), ...] joined on the frame's per-channel seq.  The role
+    rides in the join key: shard0 and shard1 processes both talk
+    slot->slot with independent seq counters, and mixing their
+    frames would fabricate clock quads."""
     send: dict[tuple, float] = {}
     ack: dict[tuple, float] = {}
     recv: dict[tuple, float] = {}
     resp: dict[tuple, float] = {}
     for n in nodes:
         slot = n["slot"]
+        role = n.get("role", "server")
         for e in n["events"]:
             if e["c"] != "frame":
                 continue
             if e["dir"] == "send":
-                send[(slot, e["peer"], e["seq"])] = e["t"]
+                send[(role, slot, e["peer"], e["seq"])] = e["t"]
             elif e["dir"] == "ack":
-                ack[(slot, e["peer"], e["seq"])] = e["t"]
+                ack[(role, slot, e["peer"], e["seq"])] = e["t"]
             elif e["dir"] == "recv":
-                recv[(e["src"], slot, e["seq"])] = e["t"]
+                recv[(role, e["src"], slot, e["seq"])] = e["t"]
             elif e["dir"] == "resp":
-                resp[(e["src"], slot, e["seq"])] = e["t"]
-    quads: dict[tuple[int, int], list] = {}
+                resp[(role, e["src"], slot, e["seq"])] = e["t"]
+    quads: dict[tuple, list] = {}
     for key, t0 in send.items():
         t1, t2, t3 = recv.get(key), resp.get(key), ack.get(key)
         if t1 is None or t2 is None or t3 is None:
             continue
-        quads.setdefault((key[0], key[1]), []).append(
+        role, a, b, _seq = key
+        quads.setdefault(((a, role), (b, role)), []).append(
             (t0, t1, t2, t3))
     return quads
 
 
-def align(nodes: list[dict]) -> dict[int, float]:
-    """slot -> clock offset vs the reference node (subtract it from
-    a node's event times to land on the reference clock).  The
-    reference is the slot with the most span events (normally the
-    serving leader)."""
+def align(nodes: list[dict]) -> dict[tuple[int, str], float]:
+    """(slot, role) -> clock offset vs the reference node (subtract
+    it from a node's event times to land on the reference clock).
+    The reference is the process with the most span events (normally
+    the serving leader)."""
     quads = _frame_quads(nodes)
     # pair offsets: receiver clock minus sender clock (NTP midpoint)
-    pair_off: dict[tuple[int, int], float] = {}
+    pair_off: dict[tuple, float] = {}
     for (a, b), qs in quads.items():
         ests = sorted(((t1 - t0) + (t2 - t3)) / 2
                       for t0, t1, t2, t3 in qs)
         pair_off[(a, b)] = ests[len(ests) // 2]
-    spans_per_slot = {
-        n["slot"]: sum(1 for e in n["events"] if e["c"] == "span")
-        for n in nodes}
-    ref = max(spans_per_slot, key=spans_per_slot.get)
+    spans_per_nid: dict[tuple[int, str], int] = {}
+    for n in nodes:
+        spans_per_nid[_nid(n)] = spans_per_nid.get(_nid(n), 0) + sum(
+            1 for e in n["events"] if e["c"] == "span")
+    ref = max(spans_per_nid, key=spans_per_nid.get)
     off = {ref: 0.0}
     # BFS over the (undirected) pair graph
     frontier = [ref]
@@ -134,12 +154,12 @@ def align(nodes: list[dict]) -> dict[int, float]:
                 off[a] = off[b] - ab
                 frontier.append(a)
     for n in nodes:
-        if n["slot"] not in off:
+        if _nid(n) not in off:
             # no traced exchange with the aligned set: leave its
             # events out rather than stitch on a wild clock
-            print(f"trace_stitch: WARNING slot {n['slot']} has no "
-                  f"alignment path to slot {ref}; skipping its "
-                  f"events", file=sys.stderr)
+            print(f"trace_stitch: WARNING node {_nid_s(_nid(n))} "
+                  f"has no alignment path to {_nid_s(ref)}; "
+                  f"skipping its events", file=sys.stderr)
     return off
 
 
@@ -154,21 +174,21 @@ def stitch(nodes: list[dict]) -> dict:
     unrelated proposals into one timeline.  We keep the incarnation
     with the newest wall anchor (the one that served last) and warn;
     stitch an earlier incarnation by passing only its files."""
-    by_slot: dict[int, dict] = {}
+    by_nid: dict[tuple[int, str], dict] = {}
     for n in nodes:
-        cur = by_slot.get(n["slot"])
+        cur = by_nid.get(_nid(n))
         if cur is None:
-            by_slot[n["slot"]] = n
+            by_nid[_nid(n)] = n
             continue
         newer, older = ((n, cur) if n.get("wall_anchor", 0)
                         >= cur.get("wall_anchor", 0) else (cur, n))
-        print(f"trace_stitch: WARNING slot {n['slot']} has multiple "
-              f"incarnations; keeping {newer.get('_file')}, "
-              f"dropping {older.get('_file')}", file=sys.stderr)
-        by_slot[n["slot"]] = newer
-    nodes = list(by_slot.values())
+        print(f"trace_stitch: WARNING node {_nid_s(_nid(n))} has "
+              f"multiple incarnations; keeping {newer.get('_file')},"
+              f" dropping {older.get('_file')}", file=sys.stderr)
+        by_nid[_nid(n)] = newer
+    nodes = list(by_nid.values())
     offsets = align(nodes)
-    aligned = [n for n in nodes if n["slot"] in offsets]
+    aligned = [n for n in nodes if _nid(n) in offsets]
 
     # per-(origin, trace) timeline: stage -> earliest aligned t
     timelines: dict[tuple[int, int], dict[str, float]] = {}
@@ -178,19 +198,23 @@ def stitch(nodes: list[dict]) -> dict:
         if stage not in tl or t < tl[stage]:
             tl[stage] = t
 
-    # frame events indexed per trace for the network hop legs
+    # frame events indexed per trace for the network hop legs; the
+    # recording process's role joins the key — co-hosted shard rings
+    # reuse (origin, trace) ids, and the same proposal IS recorded
+    # under the same role on every host it touches
     for n in aligned:
-        off = offsets[n["slot"]]
+        off = offsets[_nid(n)]
+        role = n.get("role", "server")
         for e in n["events"]:
             if e["c"] == "span":
-                note((e["origin"], e["trace"]), e["stage"],
+                note((role, e["origin"], e["trace"]), e["stage"],
                      e["t"] - off)
             elif e["c"] == "frame" and "traces" in e:
                 leg = {"send": "net_send", "recv": "net_recv"}.get(
                     e["dir"])
                 if leg:
                     for tid, org in e["traces"]:
-                        note((org, tid), leg, e["t"] - off)
+                        note((role, org, tid), leg, e["t"] - off)
 
     complete = []
     partial = 0
@@ -233,7 +257,9 @@ def stitch(nodes: list[dict]) -> dict:
     # must count ONCE, not once per co-hosted node.
     budget: dict[str, dict[str, float]] = {}
     seen_pids: set = set()
-    for n in aligned:
+    # budget sums need no clock alignment — include processes (e.g.
+    # the ingest/worker roles) that never exchange traced frames
+    for n in nodes:
         pid = n.get("pid")
         if pid and pid in seen_pids:
             continue
@@ -250,10 +276,14 @@ def stitch(nodes: list[dict]) -> dict:
         for k in ("wall_s", "cpu_s", "device_s"):
             row[k] = round(row[k], 4)
 
+    plain = all(n.get("role", "server") == "server" for n in aligned)
     return {
-        "nodes": sorted(n["slot"] for n in aligned),
-        "offsets_s": {str(s): round(o, 6)
-                      for s, o in sorted(offsets.items())},
+        # back-compat: all-default-role reports keep bare slot ints;
+        # role-split reports name each process "slot/role"
+        "nodes": (sorted(n["slot"] for n in aligned) if plain
+                  else sorted(_nid_s(_nid(n)) for n in aligned)),
+        "offsets_s": {_nid_s(nid): round(o, 6)
+                      for nid, o in sorted(offsets.items())},
         "traces": len(timelines),
         "complete": len(complete),
         "partial": partial,
