@@ -44,6 +44,20 @@ that cost whole rounds and that the 6-minute suite cannot see:
   constructed without a bound on the server/store hot paths — the
   PR-9 BoundedEventQueue lesson as a rule; external bounds need a
   baseline justification (PR 12).
+- **lock-order** (lockorder.py): the GLOBAL lock-acquisition graph
+  (instance + module-level locks, held sets propagated across call
+  edges via the shared concurrency model in concmodel.py) — cycles
+  are potential cross-module deadlocks (PR 16).
+- **blocking-under-lock** (blocking.py): fsync/socket/sleep/
+  blocking-queue/subprocess/jit-dispatch operations reachable while
+  a hot-path lock (world lock, hub mutex, server lock, frontdoor
+  loop lock, ...) is held — the static form of the PR-6 stall class
+  (PR 16).
+- **thread-ownership** (ownership.py): ``# owner: <domain>``
+  annotations + a registry of thread/process roots; attribute
+  writes to a domain reached from a non-owner root (frontdoor
+  per-conn state, shm-ring cursors, distpipe bookkeeping) are
+  flagged (PR 16).
 
 Since PR 4 the suite is **whole-program**: ``callgraph.py`` builds a
 project import/call graph once per run (cached on the engine's
@@ -61,6 +75,7 @@ The engine is stdlib-``ast`` only — no third-party deps, safe to run
 anywhere the repo imports.
 """
 
+from .blocking import BlockingUnderLockChecker
 from .boundary import DeviceBoundaryChecker
 from .boundedq import BoundedQueueChecker
 from .callgraph import CallGraph
@@ -77,7 +92,9 @@ from .engine import (
 from .errorvocab import ErrorVocabularyChecker
 from .faultvocab import FaultVocabularyChecker
 from .locks import LockDisciplineChecker
+from .lockorder import LockOrderChecker
 from .metricsvocab import MetricsVocabularyChecker
+from .ownership import DOMAINS, Domain, OwnershipChecker
 from .purity import TracerPurityChecker
 from .seqcontig import SeqContiguityChecker
 from .shapes import StaticShapeChecker
@@ -96,21 +113,29 @@ ALL_CHECKERS = (
     SeqContiguityChecker(),
     TimeoutBandChecker(),
     BoundedQueueChecker(),
+    LockOrderChecker(),
+    BlockingUnderLockChecker(),
+    OwnershipChecker(),
 )
 
 __all__ = [
     "ALL_CHECKERS",
     "AnalysisContext",
     "Baseline",
+    "BlockingUnderLockChecker",
     "BoundedQueueChecker",
     "CallGraph",
+    "DOMAINS",
     "DeviceBoundaryChecker",
+    "Domain",
     "DurabilityOrderingChecker",
     "ErrorVocabularyChecker",
     "FaultVocabularyChecker",
     "Finding",
     "LockDisciplineChecker",
+    "LockOrderChecker",
     "MetricsVocabularyChecker",
+    "OwnershipChecker",
     "SeqContiguityChecker",
     "StaticShapeChecker",
     "TimeoutBandChecker",

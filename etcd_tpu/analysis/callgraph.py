@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import ast
 import os
+import threading
 
 from .engine import dotted_name, iter_functions, scope_map
 
@@ -113,6 +114,10 @@ class CallGraph:
         self._modules: dict[str, ModuleInfo | None] = {}
         self._sites: dict[tuple[str, str], list] | None = None
         self._rev: dict[str, set[str]] | None = None
+        self._entry_points: list[tuple[str, str]] | None = None
+        # the checker fan-out in run_checkers shares one graph
+        # across worker threads; lazy index builds are guarded
+        self._build_lock = threading.Lock()
 
     # -- module access ----------------------------------------------------
 
@@ -238,8 +243,9 @@ class CallGraph:
         """Every project call expression resolving to the definition
         at ``(relpath, scope)``: ``[(caller_relpath, caller_scope,
         ast.Call)]``."""
-        if self._sites is None:
-            self._build_sites()
+        with self._build_lock:
+            if self._sites is None:
+                self._build_sites()
         return self._sites.get((relpath, scope), [])
 
     def _build_sites(self) -> None:
@@ -260,6 +266,84 @@ class CallGraph:
                     self._sites.setdefault(
                         (tkey, tscope), []).append(
                         (rel, owner.get(node, ""), node))
+
+    # -- thread entry points ----------------------------------------------
+
+    def thread_entry_points(self) -> list[tuple[str, str]]:
+        """Unique ``(relpath, scope)`` definitions used as thread or
+        process targets anywhere in the project —
+        ``threading.Thread(target=f)``,
+        ``multiprocessing.Process(target=self._run)``, bare
+        ``Thread(target=...)``.  Each is the root of a NEW execution
+        context: the ownership checker walks the call graph from
+        these (plus the registered role mains), and held-lock
+        propagation must NOT cross into them."""
+        with self._build_lock:
+            if self._entry_points is None:
+                self._entry_points = self._find_entry_points()
+        return list(self._entry_points)
+
+    def _find_entry_points(self) -> list[tuple[str, str]]:
+        out: set[tuple[str, str]] = set()
+        for rel in self.files:
+            mi = self.module(rel)
+            if mi is None:
+                continue
+            owner = scope_map(mi.tree)
+            for node in ast.walk(mi.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = dotted_name(node.func)
+                last = fname.split(".")[-1] if fname else ""
+                if last not in ("Thread", "Process"):
+                    continue
+                head = fname.split(".")[0]
+                if "." in fname and head not in (
+                        "threading", "multiprocessing", "mp"):
+                    continue
+                target = next(
+                    (kw.value for kw in node.keywords
+                     if kw.arg == "target"), None)
+                if target is None:
+                    continue
+                key = self._resolve_spawn_target(
+                    mi, owner.get(node, ""), target)
+                if key is not None:
+                    out.add(key)
+        return sorted(out)
+
+    def _resolve_spawn_target(self, mi: ModuleInfo,
+                              spawn_scope: str,
+                              target: ast.AST
+                              ) -> tuple[str, str] | None:
+        tname = dotted_name(target)
+        if not tname:
+            return None
+        parts = tname.split(".")
+        if parts[0] in ("self", "cls") and len(parts) == 2:
+            # method target: nearest enclosing scope prefix owning
+            # a def of that name ("FrontDoor.start" -> "FrontDoor._run")
+            probe = spawn_scope
+            while "." in probe:
+                probe = probe.rsplit(".", 1)[0]
+                cand = f"{probe}.{parts[1]}"
+                if cand in mi.functions:
+                    return (mi.relpath, cand)
+            return None
+        # plain function / imported name
+        for rel, scope, _node in self.resolve_call(
+                mi.relpath, tname):
+            return (rel, scope)
+        # nested def in an enclosing function scope
+        probe = spawn_scope
+        while probe:
+            cand = f"{probe}.{tname}"
+            if cand in mi.functions:
+                return (mi.relpath, cand)
+            probe = probe.rsplit(".", 1)[0] if "." in probe else ""
+        if tname in mi.functions:
+            return (mi.relpath, tname)
+        return None
 
     # -- import closures ---------------------------------------------------
 
@@ -286,15 +370,16 @@ class CallGraph:
     def reverse_dependents(self, relpaths: set[str]) -> set[str]:
         """Transitive closure of "imports one of ``relpaths``" over
         the project (the changed files themselves excluded)."""
-        if self._rev is None:
-            rev: dict[str, set[str]] = {}
-            for rel in self.files:
-                mi = self.module(rel)
-                if mi is None:
-                    continue
-                for dep in mi.imported_modules:
-                    rev.setdefault(dep, set()).add(rel)
-            self._rev = rev
+        with self._build_lock:
+            if self._rev is None:
+                rev: dict[str, set[str]] = {}
+                for rel in self.files:
+                    mi = self.module(rel)
+                    if mi is None:
+                        continue
+                    for dep in mi.imported_modules:
+                        rev.setdefault(dep, set()).add(rel)
+                self._rev = rev
         out: set[str] = set()
         frontier = list(relpaths)
         while frontier:

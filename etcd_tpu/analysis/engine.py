@@ -21,7 +21,9 @@ import ast
 import hashlib
 import json
 import os
+import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 
@@ -59,14 +61,23 @@ class AnalysisContext:
         self._cache: dict[str, tuple[ast.AST, str]] = {}
         self._lines: dict[str, list[str]] = {}
         self._cg = None
+        self._parse_lock = threading.Lock()
 
     def parse(self, relpath: str) -> tuple[ast.AST, str]:
+        # lock-free on the hot path; checkers run on a thread pool
+        # and may miss concurrently (whole-tree checkers parse files
+        # outside the run's selection), so misses serialize
         hit = self._cache.get(relpath)
         if hit is None:
-            with open(os.path.join(self.root, relpath)) as fh:
-                source = fh.read()
-            hit = (ast.parse(source, filename=relpath), source)
-            self._cache[relpath] = hit
+            with self._parse_lock:
+                hit = self._cache.get(relpath)
+                if hit is None:
+                    path = os.path.join(self.root, relpath)
+                    with open(path) as fh:
+                        source = fh.read()
+                    hit = (ast.parse(source, filename=relpath),
+                           source)
+                    self._cache[relpath] = hit
         return hit
 
     def lines(self, relpath: str) -> list[str]:
@@ -212,11 +223,16 @@ def target_files(root: str, checkers) -> dict[str, list]:
 
 
 def _record_run_metrics(checkers, findings: list[Finding],
-                        seconds: float) -> None:
+                        seconds: float,
+                        timings: dict[str, float] | None = None
+                        ) -> None:
     """Publish the run summary through the obs registry (CATALOG
     families ``etcd_lint_findings{checker}`` /
-    ``etcd_lint_run_seconds``) — best-effort; analysis must keep
-    working even if the obs package is mid-refactor."""
+    ``etcd_lint_run_seconds{checker}``) — best-effort; analysis must
+    keep working even if the obs package is mid-refactor.  Wall time
+    is labeled per checker (fan-out means they overlap; the
+    ``_total`` child is the run's actual elapsed time, not the
+    sum)."""
     try:
         from ..obs.metrics import registry
     except Exception:  # pragma: no cover - bootstrap order
@@ -227,20 +243,30 @@ def _record_run_metrics(checkers, findings: list[Finding],
     for c in checkers:
         registry.gauge("etcd_lint_findings", checker=c.name).set(
             per.get(c.name, 0))
-    registry.gauge("etcd_lint_run_seconds").set(seconds)
+    for name, secs in (timings or {}).items():
+        registry.gauge("etcd_lint_run_seconds",
+                       checker=name).set(secs)
+    registry.gauge("etcd_lint_run_seconds",
+                   checker="_total").set(seconds)
 
 
 def run_checkers(root: str, checkers,
                  paths: list[str] | None = None,
-                 ctx: AnalysisContext | None = None
-                 ) -> list[Finding]:
+                 ctx: AnalysisContext | None = None,
+                 jobs: int | None = None) -> list[Finding]:
     """Run every checker over its target files under ``root``.
     ``paths`` restricts the run (repo-relative; ``./``-prefixes are
     normalized, and a path that selects no target file raises — a
     silent zero-findings pass on a typo'd path would read as
     clean).  Returns findings sorted by (path, line), inline
     suppressions already dropped; the run summary lands in the obs
-    registry (``etcd_lint_findings``/``etcd_lint_run_seconds``)."""
+    registry (``etcd_lint_findings``/``etcd_lint_run_seconds``).
+
+    Checkers fan out over a thread pool (``jobs`` caps the width;
+    default one thread per checker up to the CPU count).  They share
+    ONE context: the AST cache is pre-filled serially below, and the
+    call graph / concurrency model guard their lazy builds with
+    their own locks, so the per-checker work is read-mostly."""
     t0 = time.monotonic()
     if paths is not None:
         paths = [os.path.normpath(p).replace(os.sep, "/")
@@ -256,14 +282,34 @@ def run_checkers(root: str, checkers,
                 f"(targets are repo-relative, e.g. "
                 f"etcd_tpu/wal/wal.py)")
 
+    selected = [rel for rel in sorted(wanted)
+                if paths is None or rel in paths]
+    for rel in selected:
+        ctx.parse(rel)
+
+    def run_one(c) -> tuple[list[Finding], float]:
+        ct0 = time.monotonic()
+        out: list[Finding] = []
+        for rel in selected:
+            if c not in wanted[rel]:
+                continue
+            tree, source = ctx.parse(rel)
+            out.extend(c.check(rel, tree, source, root=root,
+                               ctx=ctx))
+        return out, time.monotonic() - ct0
+
+    width = max(1, min(len(checkers), jobs if jobs is not None
+                       else (os.cpu_count() or 4)))
+    timings: dict[str, float] = {}
     findings: list[Finding] = []
     seen: set[tuple[str, int]] = set()
-    for rel in sorted(wanted):
-        if paths is not None and rel not in paths:
-            continue
-        tree, source = ctx.parse(rel)
-        for c in wanted[rel]:
-            for f in c.check(rel, tree, source, root=root, ctx=ctx):
+    with ThreadPoolExecutor(max_workers=width) as pool:
+        # ex.map keeps registration order, so the dedup pass below
+        # is deterministic regardless of completion order
+        for c, (out, secs) in zip(checkers,
+                                  pool.map(run_one, checkers)):
+            timings[c.name] = secs
+            for f in out:
                 # cross-module checkers may flag a file other than
                 # the one being checked — suppression comments are
                 # honored at the FLAGGED site, and a finding reached
@@ -275,7 +321,7 @@ def run_checkers(root: str, checkers,
                     findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     _record_run_metrics(checkers, findings,
-                        time.monotonic() - t0)
+                        time.monotonic() - t0, timings)
     return findings
 
 

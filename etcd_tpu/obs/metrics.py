@@ -340,8 +340,10 @@ _DEFS = (
         labels=("checker",)),
     MetricDef(
         "etcd_lint_run_seconds", "gauge",
-        "Wall seconds of the last static-analysis run "
-        "(scripts/lint or tests/test_analysis.py)."),
+        "Wall seconds of the last static-analysis run, per checker "
+        "(checkers fan out over a thread pool, so children overlap; "
+        "checker=\"_total\" is the run's elapsed time).",
+        labels=("checker",)),
 )
 
 #: name -> MetricDef; THE metric vocabulary (lint-enforced)

@@ -88,8 +88,8 @@ class AppendPipeline:
         if depth < 1:
             raise ValueError(f"pipeline depth {depth} must be >= 1")
         self.depth = depth
-        self.epoch = 1
-        self._peers = {p: _PeerPipe() for p in range(m) if p != slot}
+        self.epoch = 1  # owner: distpipe-state
+        self._peers = {p: _PeerPipe() for p in range(m) if p != slot}  # owner: distpipe-state
 
     # -- send side --------------------------------------------------------
 
@@ -99,7 +99,7 @@ class AppendPipeline:
             return not pp.inflight
         return len(pp.inflight) < self.depth
 
-    def register(self, peer: int, *, t0: float, nbytes: int,
+    def register(self, peer: int, *, t0: float, nbytes: int,  # owner: distpipe-state
                  has_ents: bool, stripe: int,
                  n_ents: int = 0) -> FrameMeta:
         """Allocate the next seq for ``peer`` and record the frame as
@@ -134,7 +134,7 @@ class AppendPipeline:
 
     # -- ack side ---------------------------------------------------------
 
-    def ack(self, peer: int, seq: int,
+    def ack(self, peer: int, seq: int,  # owner: distpipe-state
             epoch: int) -> tuple[str, FrameMeta | None]:
         """Match one response to its in-flight frame.  Returns
         ``("ok", meta)`` or ``(reason, None)`` where reason is
@@ -148,7 +148,7 @@ class AppendPipeline:
             return "stale_seq", None
         return "ok", meta
 
-    def note_reject(self, peer: int) -> bool:
+    def note_reject(self, peer: int) -> bool:  # owner: distpipe-state
         """A lane in a matched response rejected: the follower found
         a gap (out-of-order or dropped frame).  Collapse to PROBE so
         the repair goes out as ONE catch-up frame, not a window of
@@ -163,7 +163,7 @@ class AppendPipeline:
         pp.mode = PROBE
         return True
 
-    def note_ok(self, peer: int) -> bool:
+    def note_ok(self, peer: int) -> bool:  # owner: distpipe-state
         """A matched response appended cleanly: (re)open the window.
         SNAPSHOT is sticky here by design: a need-snap lane acks
         POSITIVELY at its commit (distmember.handle_append), so an
@@ -177,7 +177,7 @@ class AppendPipeline:
         pp.mode = REPLICATE
         return True
 
-    def note_snapshot(self, peer: int) -> bool:
+    def note_snapshot(self, peer: int) -> bool:  # owner: distpipe-state
         """Every sendable lane for this peer is behind the leader's
         compaction point: stop building append windows (they would
         all be doomed need-snap frames) and hold one notification
@@ -190,7 +190,7 @@ class AppendPipeline:
         pp.mode = SNAPSHOT
         return True
 
-    def note_caught_up(self, peer: int) -> bool:
+    def note_caught_up(self, peer: int) -> bool:  # owner: distpipe-state
         """A pump-time build_append saw the peer past the compaction
         point again (its streamed install landed and the positive
         need-snap ack advanced match/next): leave SNAPSHOT via ONE
@@ -203,7 +203,7 @@ class AppendPipeline:
         pp.mode = PROBE
         return True
 
-    def fail(self, peer: int, seqs) -> list[FrameMeta]:
+    def fail(self, peer: int, seqs) -> list[FrameMeta]:  # owner: distpipe-state
         """Transport failure: the listed frames will never be acked.
         Pops them, enters PROBE (SNAPSHOT peers stay SNAPSHOT — a
         lost notification frame changes nothing about the peer being
@@ -217,7 +217,7 @@ class AppendPipeline:
             pp.mode = PROBE
         return popped
 
-    def expire(self, now: float,
+    def expire(self, now: float,  # owner: distpipe-state
                max_age: float) -> dict[int, list[FrameMeta]]:
         """Backstop sweep: frames in flight longer than ``max_age``
         can no longer be trusted to ack or fail (a transport edge
@@ -237,7 +237,7 @@ class AppendPipeline:
 
     # -- leadership transitions -------------------------------------------
 
-    def bump_epoch(self) -> int:
+    def bump_epoch(self) -> int:  # owner: distpipe-state
         """The local leadership set changed (won or lost lanes): all
         in-flight frames belong to the old reign.  Drop them, bump
         the epoch (so their late acks read stale_epoch), and re-probe

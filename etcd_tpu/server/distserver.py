@@ -544,7 +544,9 @@ class DistServer:
         # Called under self.lock with (group, gindex, payload) rows;
         # payload is the already-marshaled Request — the handoff
         # never re-marshals what raft just committed.
-        self.commit_sink = None
+        # typed (string: roles.py would be a circular import) so
+        # the concurrency model can follow sink.push -> ring.push
+        self.commit_sink: "CommitSink | None" = None
         # (group, gindex) -> trace_id for in-flight TRACED proposals
         # (sampled subset of _ack_clock's keys; guarded by self.lock)
         self._trace_live: dict[tuple[int, int], int] = {}

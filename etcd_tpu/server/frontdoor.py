@@ -416,23 +416,23 @@ class _Conn:
         self.sock = sock
         self.fd = sock.fileno()
         self.addr = addr
-        self.mode = "idle"  # idle | busy | watch | closed
-        self.rbuf = bytearray()
-        self.out = bytearray()
-        self.close_after = False
-        self.epoch = 0
-        self.tenant = None  # tenant billed for the inflight request
-        self.origin = ""
-        self.want_write = False
-        self.sink: _ConnSink | None = None
-        self.watchers: list | None = None
-        self.open_members = 0
-        self.single = False  # untagged single-watch line format
-        self.watch_count = 0  # quota units to release at teardown
-        self.keepalive = 0.0
-        self.deadline_at = 0.0
-        self.last_write = 0.0
-        self.chunked = False
+        self.mode = "idle"  # idle | busy | watch | closed  # owner: frontdoor-loop
+        self.rbuf = bytearray()  # owner: frontdoor-loop
+        self.out = bytearray()  # owner: frontdoor-loop
+        self.close_after = False  # owner: frontdoor-loop
+        self.epoch = 0  # owner: frontdoor-loop
+        self.tenant = None  # tenant billed for the inflight request  # owner: frontdoor-loop
+        self.origin = ""  # owner: frontdoor-loop
+        self.want_write = False  # owner: frontdoor-loop
+        self.sink: _ConnSink | None = None  # owner: frontdoor-loop
+        self.watchers: list | None = None  # owner: frontdoor-loop
+        self.open_members = 0  # owner: frontdoor-loop
+        self.single = False  # untagged single-watch line format  # owner: frontdoor-loop
+        self.watch_count = 0  # quota units to release at teardown  # owner: frontdoor-loop
+        self.keepalive = 0.0  # owner: frontdoor-loop
+        self.deadline_at = 0.0  # owner: frontdoor-loop
+        self.last_write = 0.0  # owner: frontdoor-loop
+        self.chunked = False  # owner: frontdoor-loop
 
 
 def _status_line(status: int) -> bytes:
@@ -535,7 +535,7 @@ class FrontDoor:
         self._wake_r.setblocking(False)
         self._wake_w.setblocking(False)
 
-        self._conns: dict[int, _Conn] = {}
+        self._conns: dict[int, _Conn] = {}  # owner: frontdoor-loop
         # bounded handoff to the worker pool; depth is an admission
         # input (queue_depth ceiling), so overload surfaces as a 429
         # at the door, not latency inside
@@ -547,8 +547,8 @@ class FrontDoor:
         self._completions: list = []
         self._wake_armed = False
 
-        self._timers: list = []
-        self._tseq = 0
+        self._timers: list = []  # owner: frontdoor-loop
+        self._tseq = 0  # owner: frontdoor-loop
         self._stopping = False
         self._threads: list[threading.Thread] = []
 

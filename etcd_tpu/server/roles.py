@@ -225,7 +225,12 @@ class RemoteEtcd:
             shard_peer_urls(peers, s)[slot] for s in range(shards)]
         self.pool = KeepAlivePool(timeout=timeout)
         self.stopping = False
-        self._index = 0
+        # per-LANE etcd_index high-water marks: slot s is written
+        # only by lane thread s (a bare shared ``self._index`` max
+        # was a check-then-act race across lanes — two interleaved
+        # updates could move the published index BACKWARD, and the
+        # 429 retry hint with it); readers take the max
+        self._hiwat = [0] * max(shards, 1)  # owner: ingest-lanes
         self.store = _StubStore(self)
         self.server_stats = _StubStats()
         self.leader_stats = _StubStats()
@@ -241,7 +246,7 @@ class RemoteEtcd:
             t.start()
 
     def index(self) -> int:
-        return self._index
+        return max(self._hiwat)
 
     def term(self) -> int:
         return 0
@@ -259,7 +264,7 @@ class RemoteEtcd:
             self._lanes[sid][0].put_nowait((rr, box, done))
         except queue.Full:
             raise EtcdOverCapacity(
-                cause="ingest lane full", index=self._index,
+                cause="ingest lane full", index=self.index(),
                 retry_after=1.0) from None
         if not done.wait(timeout if timeout else 30.0):
             raise TimeoutError("shard handoff timed out")
@@ -316,8 +321,8 @@ class RemoteEtcd:
                 code, cause, eidx = res
                 box[0] = EtcdError(code, cause, eidx)
             else:
-                if res.etcd_index > self._index:
-                    self._index = res.etcd_index
+                if res.etcd_index > self._hiwat[sid]:
+                    self._hiwat[sid] = res.etcd_index
                 box[0] = Response(event=res)
             done.set()
 

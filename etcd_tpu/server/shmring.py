@@ -106,7 +106,7 @@ class ShmRing:
     def dropped(self) -> int:
         return self._get(_OFF_DROPPED)
 
-    def bump_generation(self) -> int:
+    def bump_generation(self) -> int:  # owner: shmring-producer
         """Producer calls on (re-)attach so observers can tell a
         restarted shard from a stalled one."""
         (g,) = struct.unpack_from("<I", self._buf, _OFF_GEN)
@@ -123,7 +123,7 @@ class ShmRing:
 
     # -- producer -----------------------------------------------------------
 
-    def push(self, payload) -> bool:
+    def push(self, payload) -> bool:  # owner: shmring-producer
         """Appends one record; returns False (and counts a drop) if
         it doesn't fit. Records larger than capacity - 2*_LEN - 1
         can never fit and always drop."""
@@ -157,7 +157,7 @@ class ShmRing:
 
     # -- consumer -----------------------------------------------------------
 
-    def _peek(self) -> tuple[memoryview, int] | None:
+    def _peek(self) -> tuple[memoryview, int] | None:  # owner: shmring-consumer
         """Returns (payload view, consumed byte span) or None."""
         head, tail = self.head, self.tail
         if head == tail:
@@ -189,7 +189,7 @@ class ShmRing:
                          _HDR_SIZE + pos + _LEN + n]
         return view, skipped + _LEN + n
 
-    def pop(self) -> bytes | None:
+    def pop(self) -> bytes | None:  # owner: shmring-consumer
         """Copies out the next record and advances, or None if
         empty."""
         got = self._peek()

@@ -1337,5 +1337,428 @@ def test_scripts_lint_changed_smoke():
     assert "lint --changed:" in r.stdout
 
 
+# -- concurrency suite: lock-order / blocking-under-lock / ownership ----------
+
+
+_DEADLOCK = """
+    import threading
+
+    class Pair:
+        def __init__(self):
+            self.a = threading.Lock()
+            self.b = threading.Lock()
+
+        def forward(self):
+            with self.a:
+                with self.b:
+                    return 1
+
+        def backward(self):
+            with self.b:
+                with self.a:
+                    return 2
+"""
+
+_DEADLOCK_OK = """
+    import threading
+
+    class Pair:
+        def __init__(self):
+            self.a = threading.Lock()
+            self.b = threading.Lock()
+
+        def forward(self):
+            with self.a:
+                with self.b:
+                    return 1
+
+        def also_forward(self):
+            with self.a:
+                with self.b:
+                    return 2
+"""
+
+
+def test_lockorder_fires_on_seeded_cycle(tmp_path):
+    from etcd_tpu.analysis import LockOrderChecker
+
+    root = _fixture_root(tmp_path, "etcd_tpu/server/pair.py",
+                         _DEADLOCK)
+    findings = run_checkers(root, [LockOrderChecker()])
+    assert _rules(findings) == {"lock-cycle"}
+    (f,) = findings
+    assert "Pair.a" in f.detail and "Pair.b" in f.detail
+
+
+def test_lockorder_quiet_on_consistent_order(tmp_path):
+    from etcd_tpu.analysis import LockOrderChecker
+
+    root = _fixture_root(tmp_path, "etcd_tpu/server/pair.py",
+                         _DEADLOCK_OK)
+    assert run_checkers(root, [LockOrderChecker()]) == []
+
+
+def test_lockorder_fires_on_cross_module_cycle(tmp_path):
+    """The cycle the class-local lock-discipline checker CANNOT see:
+    each module's nesting is clean, the inversion only appears when
+    call edges carry held locks across files."""
+    from etcd_tpu.analysis import LockOrderChecker
+
+    _fixture_root(tmp_path, "etcd_tpu/server/xmod.py", """
+        import threading
+        from etcd_tpu.server.ymod import Helper
+
+        class Front:
+            def __init__(self):
+                self.lk = threading.Lock()
+                self.h = Helper()
+
+            def ping(self):
+                with self.lk:
+                    return 1
+
+            def forward(self):
+                with self.lk:
+                    self.h.grab()
+    """)
+    root = _fixture_root(tmp_path, "etcd_tpu/server/ymod.py", """
+        import threading
+
+        class Helper:
+            def __init__(self):
+                self.lk = threading.Lock()
+
+            def grab(self):
+                with self.lk:
+                    return 1
+
+            def backward(self, front: "Front"):
+                with self.lk:
+                    front.ping()
+    """)
+    findings = run_checkers(root, [LockOrderChecker()])
+    assert _rules(findings) == {"lock-cycle"}
+    (f,) = findings
+    assert "Front.lk" in f.detail and "Helper.lk" in f.detail
+
+
+def test_lockorder_suppression_on_closing_edge(tmp_path):
+    from etcd_tpu.analysis import LockOrderChecker
+
+    root = _fixture_root(tmp_path, "etcd_tpu/server/pair.py", """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def forward(self):
+                with self.a:
+                    with self.b:  # lint: ok(lock-order)
+                        return 1
+
+            def backward(self):
+                with self.b:
+                    with self.a:
+                        return 2
+    """)
+    assert run_checkers(root, [LockOrderChecker()]) == []
+
+
+_HOT = frozenset({"Srv.lk"})
+
+
+def test_blocking_fires_in_callee_under_hot_lock(tmp_path):
+    """The op lives in a CALLEE; only entry-held propagation across
+    the call edge connects it to the lock."""
+    from etcd_tpu.analysis import BlockingUnderLockChecker
+
+    root = _fixture_root(tmp_path, "etcd_tpu/server/srv.py", """
+        import os
+        import threading
+
+        class Srv:
+            def __init__(self):
+                self.lk = threading.Lock()
+
+            def serve(self):
+                with self.lk:
+                    self._flush(3)
+
+            def _flush(self, fd):
+                os.fsync(fd)
+    """)
+    findings = run_checkers(
+        root, [BlockingUnderLockChecker(hot_locks=_HOT)])
+    assert _rules(findings) == {"blocking-fsio"}
+    (f,) = findings
+    assert f.scope == "Srv._flush"
+    assert "Srv.lk" in f.detail
+
+
+def test_blocking_quiet_outside_lock_and_on_cold_locks(tmp_path):
+    from etcd_tpu.analysis import BlockingUnderLockChecker
+
+    root = _fixture_root(tmp_path, "etcd_tpu/server/srv.py", """
+        import os
+        import threading
+
+        class Srv:
+            def __init__(self):
+                self.lk = threading.Lock()
+                self.cold = threading.Lock()
+
+            def serve(self):
+                with self.lk:
+                    n = 1
+                self._flush(3)
+
+            def chilled(self):
+                with self.cold:
+                    os.fsync(3)
+
+            def _flush(self, fd):
+                os.fsync(fd)
+    """)
+    assert run_checkers(
+        root, [BlockingUnderLockChecker(hot_locks=_HOT)]) == []
+
+
+def test_blocking_allowed_pairs_and_suppression(tmp_path):
+    from etcd_tpu.analysis import BlockingUnderLockChecker
+
+    body = """
+        import time
+        import threading
+
+        class Srv:
+            def __init__(self):
+                self.lk = threading.Lock()
+
+            def serve(self):
+                with self.lk:
+                    time.sleep(0.1)%s
+    """
+    root = _fixture_root(tmp_path, "etcd_tpu/server/srv.py",
+                         body % "")
+    checker = BlockingUnderLockChecker(
+        hot_locks=_HOT, allowed_pairs=frozenset({("Srv.lk",
+                                                  "sleep")}))
+    assert run_checkers(root, [checker]) == []
+    root = _fixture_root(tmp_path, "etcd_tpu/server/srv2.py",
+                         body % "  # lint: ok(blocking-under-lock)")
+    assert run_checkers(
+        root, [BlockingUnderLockChecker(hot_locks=_HOT)]) == []
+
+
+def _ownership_fixture(tmp_path, suppress: str = ""):
+    return _fixture_root(tmp_path, "etcd_tpu/server/zmod.py", f"""
+        import threading
+
+        class State:
+            def __init__(self):
+                self.cursor = 0  # owner: loop
+
+        class Owner:
+            def __init__(self, st: "State"):
+                self.st = st
+
+            def run(self):
+                self.st.cursor = 1
+
+        class Intruder:
+            def __init__(self, st: "State"):
+                self.st = st
+
+            def poke(self):
+                self.st.cursor = 2{suppress}
+
+        def main():
+            st = State()
+            threading.Thread(target=Owner(st).run).start()
+            threading.Thread(target=Intruder(st).poke).start()
+    """)
+
+
+def _loop_domain():
+    from etcd_tpu.analysis import Domain
+
+    return {"loop": Domain(
+        owners=(("etcd_tpu/server/zmod.py", "Owner.run"),),
+        doc="seeded fixture domain")}
+
+
+def test_ownership_fires_on_non_owner_thread_write(tmp_path):
+    from etcd_tpu.analysis import OwnershipChecker
+
+    root = _ownership_fixture(tmp_path)
+    findings = run_checkers(root, [OwnershipChecker(
+        domains=_loop_domain(), extra_roots=())])
+    assert _rules(findings) == {"non-owner-write"}
+    (f,) = findings
+    assert f.scope == "Intruder.poke"
+    assert "Intruder.poke" in f.message
+    # the owner's write from its own thread root is NOT among them
+    assert all(x.scope != "Owner.run" for x in findings)
+
+
+def test_ownership_suppression_and_unknown_domain(tmp_path):
+    from etcd_tpu.analysis import OwnershipChecker
+
+    root = _ownership_fixture(
+        tmp_path, "  # lint: ok(thread-ownership)")
+    assert run_checkers(root, [OwnershipChecker(
+        domains=_loop_domain(), extra_roots=())]) == []
+
+    root = _fixture_root(tmp_path, "etcd_tpu/server/qmod.py", """
+        class Q:
+            def __init__(self):
+                self.x = 0  # owner: not-registered
+    """)
+    findings = run_checkers(root, [OwnershipChecker(
+        domains=_loop_domain(), extra_roots=())])
+    assert _rules(findings) == {"unknown-domain"}
+
+
+def test_ownership_guard_lock_escape(tmp_path):
+    """A guarded domain admits non-owner roots that hold the guard
+    lock at the access site (the distpipe contract); dropping the
+    lock re-arms the finding."""
+    from etcd_tpu.analysis import Domain, OwnershipChecker
+
+    body = """
+        import threading
+
+        class State:
+            def __init__(self):
+                self.lk = threading.Lock()
+                self.cursor = 0  # owner: loop
+
+        class Owner:
+            def __init__(self, st: "State"):
+                self.st = st
+
+            def run(self):
+                self.st.cursor = 1
+
+        class Intruder:
+            def __init__(self, st: "State"):
+                self.st = st
+
+            def poke(self):
+                %s
+                    self.st.cursor = 2
+
+        def main():
+            st = State()
+            threading.Thread(target=Owner(st).run).start()
+            threading.Thread(target=Intruder(st).poke).start()
+    """
+    domains = {"loop": Domain(
+        owners=(("etcd_tpu/server/zmod.py", "Owner.run"),),
+        doc="guarded fixture domain", guard="State.lk")}
+
+    root = _fixture_root(tmp_path, "etcd_tpu/server/zmod.py",
+                         body % "with self.st.lk:")
+    assert run_checkers(root, [OwnershipChecker(
+        domains=domains, extra_roots=())]) == []
+
+    root = _fixture_root(tmp_path, "etcd_tpu/server/zmod.py",
+                         body % "if True:")
+    findings = run_checkers(root, [OwnershipChecker(
+        domains=domains, extra_roots=())])
+    assert _rules(findings) == {"non-owner-write"}
+    assert "without its guard lock State.lk" in findings[0].message
+
+
+def test_ownership_annotations_pin_real_server_state():
+    """Drift guard: the in-tree ``# owner:`` annotations must keep
+    naming the attributes/methods the PR-15/16 ownership story is
+    about — silently dropping one would hollow out the checker
+    without failing any fixture."""
+    import re
+
+    owner_re = re.compile(
+        r"(?:self\.(\w+)\s*[:=]|def\s+(\w+)\().*#\s*owner:\s*(\S+)")
+    tagged: dict[str, set[str]] = {}
+    for rel in ("etcd_tpu/server/frontdoor.py",
+                "etcd_tpu/server/shmring.py",
+                "etcd_tpu/server/distpipe.py",
+                "etcd_tpu/server/roles.py"):
+        with open(os.path.join(REPO, rel)) as fh:
+            for ln in fh:
+                m = owner_re.search(ln)
+                if m:
+                    tagged.setdefault(m.group(3), set()).add(
+                        m.group(1) or m.group(2))
+    assert {"mode", "rbuf", "out", "watchers",
+            "deadline_at"} <= tagged.get("frontdoor-loop", set())
+    assert {"push", "bump_generation"} <= tagged.get(
+        "shmring-producer", set())
+    assert {"pop", "_peek"} <= tagged.get("shmring-consumer", set())
+    assert {"register", "ack", "bump_epoch"} <= tagged.get(
+        "distpipe-state", set())
+    assert "_hiwat" in tagged.get("ingest-lanes", set())
+    # and every tagged domain is registered (checker enforces it on
+    # the tree; this keeps the registry and annotations honest even
+    # if the checker is ever detuned)
+    from etcd_tpu.analysis import DOMAINS
+
+    assert set(tagged) <= set(DOMAINS)
+
+
+def test_run_checkers_parallel_matches_serial(tmp_path):
+    """The thread-pool fan-out must be invisible: same findings, same
+    order, as a jobs=1 run over the same tree."""
+    from etcd_tpu.analysis import (
+        BoundedQueueChecker,
+        DurabilityOrderingChecker,
+        LockOrderChecker,
+    )
+
+    _fixture_root(tmp_path, "etcd_tpu/server/pair.py", _DEADLOCK)
+    _fixture_root(tmp_path, "etcd_tpu/server/mailbox.py", """
+        import queue
+
+        class M:
+            def __init__(self):
+                self.q = queue.Queue()
+    """)
+    root = _fixture_root(tmp_path, "etcd_tpu/wal/wal.py", """
+        class W:
+            def bad(self, data):
+                self.f.write(data)
+                return 1
+    """)
+    checkers = [DurabilityOrderingChecker(), BoundedQueueChecker(),
+                LockOrderChecker()]
+    par = run_checkers(root, checkers)
+    ser = run_checkers(root, [DurabilityOrderingChecker(),
+                              BoundedQueueChecker(),
+                              LockOrderChecker()], jobs=1)
+    assert [(f.fingerprint, f.line) for f in par] == \
+        [(f.fingerprint, f.line) for f in ser]
+    assert len(par) == 3
+
+
+def test_lint_per_checker_timings_on_metrics(tmp_path):
+    from etcd_tpu.obs.exporter import render_prometheus
+
+    root = _fixture_root(tmp_path, "etcd_tpu/wal/wal.py", """
+        class W:
+            def ok(self):
+                return 1
+    """)
+    run_checkers(root, [DurabilityOrderingChecker()])
+    text = render_prometheus().decode()
+    assert ('etcd_lint_run_seconds{checker='
+            '"durability-ordering"}' in text), text
+    total = next(
+        ln for ln in text.splitlines()
+        if ln.startswith('etcd_lint_run_seconds{checker="_total"}'))
+    assert float(total.split()[-1]) > 0.0
+
+
 if __name__ == "__main__":
     raise SystemExit(pytest.main([__file__, "-q"]))

@@ -188,6 +188,22 @@ def pend(gi, val="v"):
     return _Pending(req=r, data=r.marshal(), id=r.id, group=gi)
 
 
+def _elapse_hb(leader):
+    """Rewind every per-stripe cadence stamp so the next round sees
+    an elapsed heartbeat deadline — the deterministic replacement
+    for shrinking _hb_interval and sleeping past it.  A short real
+    interval livelocks under host load: each pump->auto-ack->pump
+    cycle then takes longer than the interval, the re-pump always
+    finds the NEXT heartbeat due, and the synchronous fake transport
+    turns that into unbounded recursion (production absorbs acks on
+    peerlink reader threads, so only this harness can recurse).
+    Rewinding stamps keeps the big default interval: the first round
+    is due, its own sends re-stamp 'now', and the recursion ends."""
+    for pp in leader.pipe._peers.values():
+        for st in pp.last_send:
+            pp.last_send[st] -= leader._hb_interval + 1.0
+
+
 def settle(leader, net):
     """Run empty rounds with full auto transport until nothing is in
     flight and commit covers last (election entries etc.)."""
@@ -462,11 +478,11 @@ def test_striped_pump_covers_partially_led_lanes(cluster):
     leader._campaign(odd)
     assert (leader.mr.is_leader() == odd).all()
     net.auto_peers = {1, 2}
-    # short (not zero: a zero interval + synchronous fake acks would
-    # recurse pump->ack->pump forever) heartbeat deadline, already
-    # elapsed when the round runs
-    leader._hb_interval = 0.01
-    time.sleep(0.03)
+    # heartbeat deadline already elapsed when the round runs (never
+    # sent = stamp 0.0, i.e. due); the interval itself stays at the
+    # fixture's huge default so auto-acked re-pumps go quiet once
+    # their own sends re-stamp the cadence
+    _elapse_hb(leader)
     n0 = len(net.sent_to(1))
     leader._leader_round([pend(1, "x")])
     frames = net.sent_to(1)[n0:]
@@ -481,7 +497,7 @@ def test_striped_pump_covers_partially_led_lanes(cluster):
     leader._campaign(~odd & ~leader.mr.is_leader())
     assert leader.mr.is_leader().all()
     settle(leader, net)
-    time.sleep(0.03)                   # both stripes' deadlines pass
+    _elapse_hb(leader)                 # both stripes' deadlines pass
     n1 = len(net.sent_to(1))
     leader._leader_round([])
     hb = net.sent_to(1)[n1:]
