@@ -558,6 +558,16 @@ class EtcdRequestHandler(BaseHTTPRequestHandler):
             from ..utils.trace import tracer
 
             body = tracer.snapshot_json()
+        elif sub == "slo":
+            # declared-objective burn-rate verdict over the
+            # windowed-delta ring (PR 17 SLO layer)
+            from ..obs import slo as _slo
+
+            body = _slo.default_verdict_json()
+        elif sub == "timeseries":
+            from ..obs import timeseries as _timeseries
+
+            body = _timeseries.start_default().snapshot_json()
         else:
             self._reply(404, b"404 page not found\n")
             return

@@ -334,6 +334,46 @@ _DEFS = (
         "degradation: writes rejected with errorCode 405, reads "
         "serve, recovery probes the disk with backoff), else 0."),
     MetricDef(
+        "etcd_profile_samples_total", "counter",
+        "Sampling-profiler stack samples (PR 17 always-on "
+        "profiler), attributed to the innermost active "
+        "tracer.stage() on the sampled thread (stage; '-' when "
+        "outside every stage) and the thread-ownership domain from "
+        "analysis/ownership.py whose root the sampled stack runs "
+        "under (domain; '-' when unclassified).",
+        labels=("stage", "domain")),
+    MetricDef(
+        "etcd_profile_overhead_ratio", "gauge",
+        "Measured profiler self-cost: sampler-thread CPU seconds "
+        "over wall seconds since start (the dist_bench "
+        "--profile-overhead gate bounds the end-to-end acked/s "
+        "cost at 2%; this gauge is the in-process floor)."),
+    MetricDef(
+        "etcd_slo_burn_rate", "gauge",
+        "Error-budget burn rate per declared objective "
+        "(obs/slo.py): observed bad fraction over the objective's "
+        "window divided by the allowed bad fraction — 1.0 burns "
+        "the budget exactly at the sustainable rate, >1 is "
+        "burning, 0 with no samples.", labels=("objective",)),
+    MetricDef(
+        "etcd_slo_ok", "gauge",
+        "1 while the objective meets its target over its window "
+        "(vacuously 1 with no samples), else 0.",
+        labels=("objective",)),
+    MetricDef(
+        "etcd_role_up", "gauge",
+        "Supervisor-merged liveness per child role (PR 17): 1 "
+        "while the last /mraft/obs scrape is fresh, 0 while the "
+        "role is down or mid-respawn (its last-known samples stay "
+        "in the merged view, stale-marked — never a scrape "
+        "error).", labels=("role",)),
+    MetricDef(
+        "etcd_obs_scrape_total", "counter",
+        "Supervisor scrape attempts per child role by outcome: "
+        "ok | error (child unreachable or bad snapshot — the "
+        "merged view serves stale instead of failing).",
+        labels=("role", "outcome")),
+    MetricDef(
         "etcd_lint_findings", "gauge",
         "Findings per checker in the last static-analysis run "
         "(baselined findings included; suppressed ones not).",
@@ -429,21 +469,26 @@ class Histogram:
             return 0.0
         return ring[min(len(ring) - 1, int(len(ring) * q))]
 
-    def snapshot(self) -> dict:
+    def snapshot(self, light: bool = False) -> dict:
         # ONE critical section: buckets copied with count/sum/ring so
         # the +Inf cumulative always equals _count (the Prometheus
         # invariant a concurrent observe() between two lock takes
-        # would break)
+        # would break).  ``light`` skips the exact-percentile ring
+        # sort — the dominant snapshot cost — for per-second callers
+        # (the time-series ring, the supervisor scrape) that only
+        # consume count/sum/buckets.
         with self._lock:
             count, total, mx = self.count, self.sum, self.max
-            ring = sorted(self._ring)
+            ring = None if light else sorted(self._ring)
             buckets = list(self.buckets)
         out = {"count": count, "sum": total, "max": mx,
                "bounds": list(self.bounds), "buckets": buckets}
-        for key, q in (("p50", 0.5), ("p90", 0.9), ("p99", 0.99),
-                       ("p999", 0.999)):
-            out[key] = (ring[min(len(ring) - 1, int(len(ring) * q))]
-                        if ring else 0.0)
+        if ring is not None:
+            for key, q in (("p50", 0.5), ("p90", 0.9),
+                           ("p99", 0.99), ("p999", 0.999)):
+                out[key] = (ring[min(len(ring) - 1,
+                                     int(len(ring) * q))]
+                            if ring else 0.0)
         return out
 
 
@@ -524,11 +569,13 @@ class Registry:
     def families(self) -> list[_Family]:
         return [self._fams[n] for n in sorted(self._fams)]
 
-    def snapshot(self) -> dict:
+    def snapshot(self, light: bool = False) -> dict:
         """JSON-ready view: every family, its kind/help, and one
         entry per labeled child (histograms carry bucket counts AND
         exact ring percentiles — the /mraft/obs and soak-artifact
-        form)."""
+        form).  ``light`` skips the ring-sorted exact percentiles
+        (the ``/mraft/obs/light`` scrape form: cheap enough for a
+        per-second cadence)."""
         out = {}
         for fam in self.families():
             samples = []
@@ -536,7 +583,7 @@ class Registry:
                 entry = {"labels": dict(zip(fam.d.labels,
                                             labelvalues))}
                 if fam.d.kind == "histogram":
-                    entry.update(child.snapshot())
+                    entry.update(child.snapshot(light=light))
                 else:
                     entry["value"] = child.get()
                 samples.append(entry)
@@ -545,9 +592,9 @@ class Registry:
                                "samples": samples}
         return out
 
-    def snapshot_json(self) -> bytes:
-        return (json.dumps(self.snapshot(), sort_keys=True)
-                + "\n").encode()
+    def snapshot_json(self, light: bool = False) -> bytes:
+        return (json.dumps(self.snapshot(light=light),
+                           sort_keys=True) + "\n").encode()
 
     def reset(self) -> None:
         """Drop every recorded sample (tests / process reuse)."""

@@ -733,6 +733,15 @@ class DistServer:
 
     def start(self) -> None:
         """Bind the peer listener and start the round loop."""
+        from ..obs import profiler as _profiler
+        from ..obs import timeseries as _timeseries
+
+        # always-on per-process observability (PR 17): the sampling
+        # profiler and the windowed-delta ring behind
+        # /mraft/obs/timeseries (idempotent; ETCD_PROFILE_HZ=0
+        # disables the sampler — the overhead-gate off arm)
+        _profiler.start_default()
+        _timeseries.start_default()
         threading.Thread(target=self._publish, daemon=True).start()
         u = urlparse(self.peer_urls[self.slot])
         handler = _make_peer_handler(self)
@@ -3805,12 +3814,31 @@ def _make_peer_handler(server: DistServer):
                 # scripts/dist_bench.py pools the three hosts'
                 # ack-RTT buckets from here
                 self._reply(200, _obs.registry.snapshot_json())
+            elif self.path == "/mraft/obs/light":
+                # no exact-percentile ring sorts: the role
+                # supervisor's per-second scrape form (PR 17)
+                self._reply(200,
+                            _obs.registry.snapshot_json(light=True))
             elif self.path == "/mraft/obs/flight":
                 # flight-recorder dump (PR 8): the ring + clock
                 # anchors + per-stage wall/cpu/device sums — what
                 # chaos_drill harvests on gate failure and
                 # scripts/trace_stitch.py merges across nodes
                 self._reply(200, server.flight.dump_json())
+            elif self.path == "/mraft/obs/timeseries":
+                # windowed-delta ring (PR 17): rates and windowed
+                # percentiles over the last ETCD_TS_RETENTION steps
+                from ..obs import timeseries as _timeseries
+
+                self._reply(200,
+                            _timeseries.start_default()
+                            .snapshot_json())
+            elif self.path == "/mraft/obs/slo":
+                # declared-objective verdict (PR 17): burn rates
+                # over the ring — same body as GET /v2/stats/slo
+                from ..obs import slo as _slo
+
+                self._reply(200, _slo.default_verdict_json())
             elif self.path == "/mraft/leaders":
                 # leadership-transition trace for the chaos drill's
                 # recovery decomposition; lock-free reads of small

@@ -63,7 +63,17 @@ import threading
 import time
 import urllib.request
 
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..obs import aggregate as _aggregate
 from ..obs import metrics as _obs
+from ..obs import profiler as _profiler
+from ..obs import slo as _slo
+from ..obs import timeseries as _timeseries
+from ..obs.exporter import (
+    CONTENT_TYPE as _PROM_CTYPE,
+    render_prometheus_snapshot,
+)
 from ..obs.flight import FlightRecorder, install_crash_dump
 from ..utils.errors import (
     ECODE_RAFT_INTERNAL,
@@ -441,17 +451,35 @@ class RemoteEtcd:
 
 
 def _obs_routes(flight: FlightRecorder) -> dict:
-    """/mraft/obs + /mraft/obs/flight for a role process — same
-    shapes the shard's peer tier serves, so harvest_rings and the
-    bench stage scraper address every role uniformly."""
+    """/mraft/obs + /mraft/obs/flight + /mraft/obs/timeseries +
+    /mraft/obs/slo for a role process — same shapes the shard's
+    peer tier serves, so harvest_rings, the bench stage scraper,
+    the chaos forensics dump and scripts/doctor.py address every
+    role uniformly."""
     return {
         "/mraft/obs": lambda *a: (
             200, {"Content-Type": "application/json"},
             _obs.registry.snapshot_json()),
+        "/mraft/obs/light": lambda *a: (
+            200, {"Content-Type": "application/json"},
+            _obs.registry.snapshot_json(light=True)),
         "/mraft/obs/flight": lambda *a: (
             200, {"Content-Type": "application/json"},
             flight.dump_json()),
+        "/mraft/obs/timeseries": lambda *a: (
+            200, {"Content-Type": "application/json"},
+            _timeseries.start_default().snapshot_json()),
+        "/mraft/obs/slo": lambda *a: (
+            200, {"Content-Type": "application/json"},
+            _slo.default_verdict_json()),
     }
+
+
+def _start_role_obs() -> None:
+    """Always-on per-role observability: the sampling profiler and
+    the windowed-delta ring (both idempotent, both env-gated)."""
+    _profiler.start_default()
+    _timeseries.start_default()
 
 
 def run_ingest(args) -> None:
@@ -459,6 +487,7 @@ def run_ingest(args) -> None:
 
     _arm_parent_death()
     done = _arm_signals()
+    _start_role_obs()
     m = len(args.peers.split(","))
     flight = FlightRecorder(node=f"{args.name}-ingest",
                             slot=args.slot, role="ingest")
@@ -524,6 +553,7 @@ def run_worker(args) -> None:
 
     _arm_parent_death()
     done = _arm_signals()
+    _start_role_obs()
     m = len(args.peers.split(","))
     port = worker_port(args.client_port, m)
     flight = FlightRecorder(node=f"{args.name}-worker",
@@ -611,6 +641,7 @@ def run_shard(args) -> None:
 
     _arm_parent_death()
     done = _arm_signals()
+    _start_role_obs()
     s = args.shard_index
     peers = args.peers.split(",")
     g_local = args.groups // args.shards
@@ -674,6 +705,164 @@ def _serve_forever(done: threading.Event, on_stop) -> None:
 ROLES_FILE = "roles.json"
 
 
+def supervisor_obs_port(client_port: int, m: int) -> int:
+    """The supervisor's merged-plane port.  Hosts already occupy
+    the [client, client+m) ingest and [client+m, client+2m) worker
+    bands; +2m lands every host's supervisor in a third disjoint
+    band."""
+    return client_port + 2 * m
+
+
+class SupervisorObs:
+    """The supervisor's merged observability plane (PR 17
+    tentpole): one scrape thread pulls every child role's
+    ``/mraft/obs`` snapshot into a
+    :class:`~..obs.aggregate.MetricsAggregator` (stale-marked,
+    monotone across respawns), feeds the merged cumulative view
+    through a supervisor-level time-series ring, and serves:
+
+    - ``/metrics`` — one Prometheus exposition of every role with a
+      ``role`` label (0.0.4-conformant, HELP/TYPE once per family);
+    - ``/mraft/obs`` — the merged JSON view + per-role liveness;
+    - ``/mraft/obs/timeseries`` — the merged windowed-delta ring;
+    - ``/mraft/obs/slo`` and ``/v2/stats/slo`` — the cluster-level
+      SLO verdict evaluated over the merged ring;
+    - ``/mraft/roles`` — role -> {port, up, stale_s} discovery for
+      scripts/doctor.py.
+
+    A down/mid-respawn child never yields a scrape error from these
+    endpoints: its last-known samples stay, ``etcd_role_up`` drops
+    to 0, and the next incarnation folds in monotone."""
+
+    def __init__(self, targets: dict[str, int], port: int,
+                 interval: float | None = None,
+                 stale_after: float = _aggregate.STALE_AFTER_S,
+                 self_registry: _obs.Registry | None = None,
+                 host: str = "127.0.0.1"):
+        self.targets = dict(targets)
+        self.port = port
+        if interval is None:
+            try:
+                interval = float(os.environ.get(
+                    "ETCD_OBS_SCRAPE_S") or 1.0)
+            except ValueError:
+                interval = 1.0
+        self.interval = interval
+        self.host = host
+        self._self_reg = self_registry
+        self.agg = _aggregate.MetricsAggregator(
+            stale_after=stale_after)
+        self.ts = _timeseries.TimeSeries(self.agg.merged_families,
+                                         step=interval)
+        self.slo = _slo.SLOEvaluator(self.ts,
+                                     registry=self_registry)
+        self._stop = threading.Event()
+        self._httpd: ThreadingHTTPServer | None = None
+
+    # -- scraping ---------------------------------------------------------
+
+    def scrape_once(self, timeout: float = 1.5) -> None:
+        """One scrape round over every child, then one ring step
+        over the merged view.  Child failures are absorbed (counted,
+        stale-marked) — the merged plane never errors with them."""
+        for role, port in sorted(self.targets.items()):
+            # the light form: no exact-percentile ring sorts on the
+            # child — the merge only consumes count/sum/buckets,
+            # and the scrape runs every second on a shared core
+            url = f"http://{self.host}:{port}/mraft/obs/light"
+            try:
+                with urllib.request.urlopen(url, timeout=timeout) \
+                        as resp:
+                    snap = json.loads(resp.read())
+                self.agg.observe(role, snap)
+                outcome = "ok"
+            except Exception:
+                self.agg.scrape_failed(role)
+                outcome = "error"
+            if self._self_reg is not None:
+                self._self_reg.counter(
+                    "etcd_obs_scrape_total", role=role,
+                    outcome=outcome).inc()
+        if self._self_reg is not None:
+            # the supervisor is itself a role in the merged view
+            self.agg.observe("supervisor",
+                             self._self_reg.snapshot(light=True))
+        self.ts.step_once()
+
+    def _scrape_loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.scrape_once()
+            except Exception:  # pragma: no cover - defensive
+                log.exception("roles: supervisor scrape failed")
+
+    # -- serving ----------------------------------------------------------
+
+    def roles_body(self) -> bytes:
+        live = self.agg.roles()
+        body = {role: dict(port=port, **live.get(role, {}))
+                for role, port in self.targets.items()}
+        body["supervisor"] = {"port": self.port, "up": True}
+        return (json.dumps({"roles": body}, sort_keys=True)
+                + "\n").encode()
+
+    def _make_handler(self):
+        obs = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # pragma: no cover - quiet
+                pass
+
+            def do_GET(self):
+                try:
+                    ctype = "application/json"
+                    if self.path == "/metrics":
+                        body = render_prometheus_snapshot(
+                            obs.agg.merged_families())
+                        ctype = _PROM_CTYPE
+                    elif self.path == "/mraft/obs":
+                        body = obs.agg.merged_json()
+                    elif self.path == "/mraft/obs/timeseries":
+                        body = obs.ts.snapshot_json()
+                    elif self.path in ("/mraft/obs/slo",
+                                       "/v2/stats/slo"):
+                        body = obs.slo.verdict_json()
+                    elif self.path == "/mraft/roles":
+                        body = obs.roles_body()
+                    else:
+                        self.send_response(404)
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length",
+                                     str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except Exception:  # pragma: no cover - conn died
+                    pass
+
+        return Handler
+
+    def start(self) -> "SupervisorObs":
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          self._make_handler())
+        self._httpd.daemon_threads = True
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True,
+                         name="supervisor-obs-http").start()
+        threading.Thread(target=self._scrape_loop, daemon=True,
+                         name="supervisor-obs-scrape").start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+
 class Supervisor:
     """Spawns and nurses the role tree for one host slot."""
 
@@ -685,6 +874,7 @@ class Supervisor:
         self.rings: list[ShmRing] = []
         self.stopping = False
         self._spawned_at: dict[str, float] = {}
+        self.obs: SupervisorObs | None = None
 
     def role_names(self) -> list[str]:
         return (["ingest", "worker"]
@@ -741,6 +931,9 @@ class Supervisor:
         tmp = path + ".tmp"
         body = {r: {"pid": p.pid, "port": self.ports[r]}
                 for r, p in self.children.items()}
+        if self.obs is not None:
+            body["supervisor"] = {"pid": os.getpid(),
+                                  "port": self.obs.port}
         with open(tmp, "w") as f:
             json.dump(body, f)
         os.replace(tmp, path)
@@ -760,6 +953,18 @@ class Supervisor:
                                       create=True))
         for role in self.role_names():
             self.spawn(role)
+        try:
+            self.obs = SupervisorObs(
+                dict(self.ports),
+                supervisor_obs_port(self.args.client_port, self.m),
+                self_registry=_obs.registry).start()
+            self._write_roles_file()  # now carries the supervisor
+        except OSError as e:
+            # the merged plane is additive — a squatted obs port
+            # must never take the serving tree down with it
+            log.warning("roles: merged obs plane unavailable: %s",
+                        e)
+            self.obs = None
 
     def wait_ready(self, timeout: float = 90.0) -> bool:
         """Every role port answers (and, with --bootstrap, every
@@ -810,6 +1015,8 @@ class Supervisor:
 
     def stop(self) -> None:
         self.stopping = True
+        if self.obs is not None:
+            self.obs.stop()
         for proc in self.children.values():
             if proc.poll() is None:
                 proc.terminate()
@@ -826,6 +1033,7 @@ class Supervisor:
 
 
 def supervise(args) -> None:
+    _profiler.start_default()
     sup = Supervisor(args)
 
     def _term(signum, frame):

@@ -233,17 +233,24 @@ def harvest_flight(tag):
     ts = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
     art = os.path.join(REPO, "trace_artifacts", f"chaos_{tag}_{ts}")
     urls = list(PEERS)
+    sup_urls = []
     if ROLES:
         # every role process is its own flight incarnation — harvest
         # each port listed in the slot's roles.json (falling back to
-        # the shard-0 peer port if a supervisor died pre-write)
+        # the shard-0 peer port if a supervisor died pre-write).
+        # The supervisor's merged-obs port (PR 17) carries no flight
+        # ring — it joins the timeseries/SLO harvest only.
         urls = []
         for s in range(3):
             try:
                 with open(f"{BASE}/d{s}/roles.json") as f:
                     info = json.load(f)
-                urls += [f"http://127.0.0.1:{r['port']}"
-                         for _, r in sorted(info.items())]
+                for name, r in sorted(info.items()):
+                    u = f"http://127.0.0.1:{r['port']}"
+                    if name == "supervisor":
+                        sup_urls.append(u)
+                    else:
+                        urls.append(u)
             except Exception:
                 urls.append(PEERS[s])
     paths = harvest_rings(urls, art, timeout=5)
@@ -252,13 +259,42 @@ def harvest_flight(tag):
               f"process(es) unreachable — their SIGTERM/crash "
               f"dumps, if any, are under "
               f"{BASE}/d*/trace_artifacts/", flush=True)
+    obs_paths = harvest_obs_plane(urls + sup_urls, art)
     print("GATE FAILURE FORENSICS — flight dumps harvested "
           f"({len(paths)}/{len(urls)} processes):", flush=True)
     for p in paths:
         print(f"  {p}", flush=True)
+    if obs_paths:
+        print(f"  + {len(obs_paths)} time-series ring / SLO "
+              f"verdict snapshot(s) (PR 17):", flush=True)
+        for p in obs_paths:
+            print(f"  {p}", flush=True)
     print(f"  stitch with: python scripts/trace_stitch.py {art}",
           flush=True)
     return paths
+
+
+def harvest_obs_plane(urls, art):
+    """Ride-along forensics (PR 17): every reachable process's
+    time-series ring (the last ~2 min of windowed deltas — the
+    rate collapse AROUND the failure, which lifetime counters
+    erase) and its SLO verdict, dropped next to the flight dumps."""
+    os.makedirs(art, exist_ok=True)
+    out = []
+    for i, u in enumerate(urls):
+        for sub, stem in (("timeseries", "timeseries"),
+                          ("slo", "slo")):
+            try:
+                with urllib.request.urlopen(
+                        f"{u}/mraft/obs/{sub}", timeout=5) as r:
+                    body = r.read()
+            except Exception:
+                continue
+            p = os.path.join(art, f"{stem}_{i}.json")
+            with open(p, "wb") as f:
+                f.write(body)
+            out.append(p)
+    return out
 
 
 def forced_gate_fail():

@@ -479,6 +479,14 @@ def run_swarm(args) -> dict:
            "streams_per_conn": streams,
            "streams_target": args.target_streams}
 
+    # the in-process observability ring (PR 17): step the default
+    # registry into windowed deltas so the row can carry last-10s
+    # rates and an SLO verdict, not just lifetime totals
+    from etcd_tpu.obs import slo as _slo
+    from etcd_tpu.obs import timeseries as _timeseries
+
+    ts_ring = _timeseries.start_default()
+
     server = start_server()
     cfg = FrontDoorConfig(
         max_conns=conns + 256,
@@ -590,6 +598,13 @@ def run_swarm(args) -> dict:
             "delivered": sum(1 for c in sample if c.events >= 1),
         }
         out["frontdoor_admission"] = fd.admission.stats()["admission"]
+        # windowed truth + error-budget verdict off the local ring
+        # (the swarm runs in-process, so the default registry IS
+        # the server's registry)
+        ts_ring.step_once()
+        out["windowed"] = _timeseries.windowed_summary(
+            [ts_ring.snapshot()])
+        out["slo"] = _slo.SLOEvaluator(ts_ring).evaluate()
     finally:
         swarm.close()
         fd.shutdown()
@@ -693,7 +708,8 @@ def main(argv=None) -> int:
         return 1
     print(f"swarm_bench ok — {out['swarm']['live_streams']} live "
           f"streams on {out['swarm']['conns_open_client']} conns, "
-          f"{out['traffic']['abuser_sheds']} typed sheds",
+          f"{out['traffic']['abuser_sheds']} typed sheds, "
+          f"slo={out.get('slo', {}).get('verdict', 'n/a')}",
           file=sys.stderr)
     return 0
 
