@@ -58,6 +58,22 @@ that cost whole rounds and that the 6-minute suite cannot see:
   writes to a domain reached from a non-owner root (frontdoor
   per-conn state, shm-ring cursors, distpipe bookkeeping) are
   flagged (PR 16).
+- **wire-bounds** (wirebounds.py): wire-derived lengths/counts in
+  the five frame formats' parse scopes must pass a dominating
+  raising length check or a schema plausibility cap
+  (wire/schema.py ``check_bound``) before sizing a loop, a
+  ``frombuffer`` view, or an allocation; the BOUNDS catalog is a
+  closed vocabulary checked both directions (PR 19).
+- **frame-totality** (frametotality.py): parse paths raise only the
+  format's typed error — unguarded struct unpacks and untyped
+  decode/json escapes are findings, and every schema-declared frame
+  kind and flag bit must reach explicit handling plus a typed
+  unknown-kind rejection (PR 19).
+- **schema-drift** (schemadrift.py): marshal/unmarshal symmetry
+  against the declarative schemas — locally re-declared struct/magic
+  literals, reordered DGB2 sections, and gogoproto field tags that
+  disagree with the declared (fnum, wiretype) pairs fail lint
+  (PR 19).
 
 Since PR 4 the suite is **whole-program**: ``callgraph.py`` builds a
 project import/call graph once per run (cached on the engine's
@@ -91,14 +107,17 @@ from .engine import (
 )
 from .errorvocab import ErrorVocabularyChecker
 from .faultvocab import FaultVocabularyChecker
+from .frametotality import FrameTotalityChecker
 from .locks import LockDisciplineChecker
 from .lockorder import LockOrderChecker
 from .metricsvocab import MetricsVocabularyChecker
 from .ownership import DOMAINS, Domain, OwnershipChecker
 from .purity import TracerPurityChecker
+from .schemadrift import SchemaDriftChecker
 from .seqcontig import SeqContiguityChecker
 from .shapes import StaticShapeChecker
 from .timeouts import TimeoutBandChecker
+from .wirebounds import WireBoundsChecker
 
 #: the registry scripts/lint and tests/test_analysis.py run
 ALL_CHECKERS = (
@@ -116,6 +135,9 @@ ALL_CHECKERS = (
     LockOrderChecker(),
     BlockingUnderLockChecker(),
     OwnershipChecker(),
+    WireBoundsChecker(),
+    FrameTotalityChecker(),
+    SchemaDriftChecker(),
 )
 
 __all__ = [
@@ -132,14 +154,17 @@ __all__ = [
     "ErrorVocabularyChecker",
     "FaultVocabularyChecker",
     "Finding",
+    "FrameTotalityChecker",
     "LockDisciplineChecker",
     "LockOrderChecker",
     "MetricsVocabularyChecker",
     "OwnershipChecker",
+    "SchemaDriftChecker",
     "SeqContiguityChecker",
     "StaticShapeChecker",
     "TimeoutBandChecker",
     "TracerPurityChecker",
+    "WireBoundsChecker",
     "load_baseline",
     "prune_baseline",
     "run_checkers",

@@ -1,0 +1,339 @@
+"""wire-bounds: wire-derived counts must be bounds-checked before
+they size anything.
+
+A parse scope (analysis/wiremodel.py) turns bytes an attacker or a
+crashed peer controls into integers.  Any such integer that reaches a
+``range()``, a ``frombuffer(count=...)``, a ``bytearray``/``bytes``
+allocation, a ``np.zeros``-style allocation, or a sequence-repeat
+(``b"\\0" * n``) without a dominating guard is a finding: a 24-byte
+hostile frame must never drive a multi-GiB allocation or a 2^31-turn
+loop.  Guards are (a) a raising ``if`` that compares the value
+(typically against ``len(data)``) or (b) a schema plausibility cap
+via ``wire/schema.py``'s ``check_bound``.
+
+The schema's ``BOUNDS`` catalog is a closed vocabulary, checked both
+ways (the fault-vocabulary pattern, PR 10): every ``check_bound``
+call site must name a declared bound with a string literal
+(``dynamic-bound-name`` / ``unregistered-bound``), and every bound
+the schema declares for this module must actually be enforced in its
+declared scope (``missing-plausibility-cap``) — so adding a schema
+cap without wiring the rejection, or vice versa, fails lint.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Checker, Finding, dotted_name, scope_map
+from .wiremodel import (SCHEMA_RELPATH, WIRE_TARGETS, module_schema,
+                        parse_scopes)
+from ..wire import schema as _schema
+
+#: calls whose results are wire-derived integers
+_SOURCE_LAST = {"unpack_from", "unpack", "uvarint", "parse_header",
+                "_parse_header", "_tag"}
+_ALLOC_LAST = {"zeros", "empty", "full"}
+
+
+def _names(expr: ast.AST) -> set[str]:
+    out: set[str] = set()
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            d = dotted_name(n)
+            if d:
+                out.add(d)
+    return out
+
+
+def _has_source_call(expr: ast.AST) -> bool:
+    for n in ast.walk(expr):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        last = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        if last in _SOURCE_LAST or last.startswith("_view_"):
+            return True
+    return False
+
+
+def _has_len_call(expr: ast.AST) -> bool:
+    return any(isinstance(n, ast.Call)
+               and isinstance(n.func, ast.Name)
+               and n.func.id == "len"
+               for n in ast.walk(expr))
+
+
+def _raises(body: list[ast.stmt]) -> bool:
+    return any(isinstance(n, (ast.Raise, ast.Return))
+               for stmt in body for n in ast.walk(stmt))
+
+
+def _target_names(t: ast.AST) -> list[str]:
+    if isinstance(t, ast.Name):
+        return [t.id]
+    if isinstance(t, ast.Attribute):
+        d = dotted_name(t)
+        return [d] if d else []
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out = []
+        for e in t.elts:
+            out.extend(_target_names(e))
+        return out
+    if isinstance(t, ast.Starred):
+        return _target_names(t.value)
+    return []
+
+
+class _TaintWalk:
+    """Per-function lexical taint walk: statement order, loops and
+    branches included; guard state is per tainted name."""
+
+    def __init__(self, checker: "WireBoundsChecker", relpath: str,
+                 scope: str, out: list[Finding]):
+        self.checker = checker
+        self.relpath = relpath
+        self.scope = scope
+        self.out = out
+        #: tainted name -> guarded?
+        self.taint: dict[str, bool] = {}
+
+    def _tainted(self, expr: ast.AST) -> set[str]:
+        return _names(expr) & set(self.taint)
+
+    def _unguarded_in(self, expr: ast.AST) -> str | None:
+        for name in sorted(self._tainted(expr)):
+            if not self.taint[name]:
+                return name
+        return None
+
+    def _finding(self, node: ast.AST, sink: str, name: str) -> None:
+        self.out.append(Finding(
+            checker=self.checker.name, path=self.relpath,
+            line=node.lineno, rule="unchecked-wire-count",
+            scope=self.scope,
+            message=f"wire-derived {name!r} reaches {sink} without "
+                    f"a dominating length check or schema "
+                    f"plausibility cap (wire/schema.py check_bound)",
+            detail=f"{sink}:{name}"))
+
+    def _scan_sinks(self, expr: ast.AST) -> None:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call):
+                f = n.func
+                last = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else "")
+                if last == "range":
+                    for a in n.args:
+                        bad = self._unguarded_in(a)
+                        if bad:
+                            self._finding(n, "range", bad)
+                            break
+                elif last == "frombuffer":
+                    for kw in n.keywords:
+                        if kw.arg == "count":
+                            bad = self._unguarded_in(kw.value)
+                            if bad:
+                                self._finding(n, "frombuffer-count",
+                                              bad)
+                elif last in ("bytearray", "bytes"):
+                    if n.args and not isinstance(n.args[0],
+                                                 ast.Subscript):
+                        bad = self._unguarded_in(n.args[0])
+                        if bad:
+                            self._finding(n, "allocation", bad)
+                elif last in _ALLOC_LAST:
+                    if n.args:
+                        bad = self._unguarded_in(n.args[0])
+                        if bad:
+                            self._finding(n, "allocation", bad)
+            elif isinstance(n, ast.BinOp) \
+                    and isinstance(n.op, ast.Mult):
+                for side, other in ((n.left, n.right),
+                                    (n.right, n.left)):
+                    if isinstance(side, (ast.List, ast.Constant)) \
+                            and isinstance(
+                                getattr(side, "value", []),
+                                (bytes, str, list)):
+                        bad = self._unguarded_in(other)
+                        if bad:
+                            self._finding(n, "sequence-repeat", bad)
+
+    def _mark_check_bound(self, expr: ast.AST) -> None:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call):
+                f = n.func
+                last = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else "")
+                if last == "check_bound" and len(n.args) >= 2:
+                    for name in self._tainted(n.args[1]):
+                        self.taint[name] = True
+
+    def _assign(self, targets: list[ast.AST],
+                value: ast.AST | None) -> None:
+        if value is None:
+            return
+        names = [t for tgt in targets for t in _target_names(tgt)]
+        if _has_source_call(value):
+            for t in names:
+                self.taint[t] = False
+            return
+        refs = self._tainted(value)
+        if refs:
+            guarded = all(self.taint[r] for r in refs)
+            for t in names:
+                self.taint[t] = guarded
+        else:
+            for t in names:
+                self.taint.pop(t, None)
+
+    def block(self, stmts: list[ast.stmt]) -> None:
+        for s in stmts:
+            if isinstance(s, ast.Assign):
+                self._scan_sinks(s.value)
+                self._mark_check_bound(s.value)
+                self._assign(s.targets, s.value)
+            elif isinstance(s, (ast.AnnAssign, ast.AugAssign)):
+                if s.value is not None:
+                    self._scan_sinks(s.value)
+                self._assign([s.target], s.value)
+            elif isinstance(s, ast.Expr):
+                self._mark_check_bound(s.value)
+                self._scan_sinks(s.value)
+            elif isinstance(s, (ast.If, ast.While)):
+                self._scan_sinks(s.test)
+                if _raises(s.body) or _raises(s.orelse):
+                    # a raising comparison dominates everything
+                    # after it: the value was rejected or bounded
+                    for name in self._tainted(s.test):
+                        self.taint[name] = True
+                self.block(s.body)
+                self.block(s.orelse)
+            elif isinstance(s, ast.For):
+                self._scan_sinks(s.iter)
+                refs = self._tainted(s.iter)
+                if refs:
+                    guarded = all(self.taint[r] for r in refs)
+                    for t in _target_names(s.target):
+                        self.taint[t] = guarded
+                self.block(s.body)
+                self.block(s.orelse)
+            elif isinstance(s, ast.Try):
+                self.block(s.body)
+                for h in s.handlers:
+                    self.block(h.body)
+                self.block(s.orelse)
+                self.block(s.finalbody)
+            elif isinstance(s, ast.With):
+                for item in s.items:
+                    self._scan_sinks(item.context_expr)
+                self.block(s.body)
+            elif isinstance(s, (ast.FunctionDef,
+                                ast.AsyncFunctionDef)):
+                self.block(s.body)
+            elif isinstance(s, (ast.Return, ast.Raise)):
+                pass  # escaping values are the caller's wire data
+            elif isinstance(s, ast.Assert):
+                self._scan_sinks(s.test)
+
+
+class WireBoundsChecker(Checker):
+    name = "wire-bounds"
+    targets = WIRE_TARGETS
+
+    def check(self, relpath: str, tree: ast.AST, source: str,
+              root: str | None = None, ctx=None) -> list[Finding]:
+        if relpath == SCHEMA_RELPATH:
+            return []
+        out: list[Finding] = []
+        scopes = parse_scopes(relpath, tree, ctx)
+        for scope, fn in scopes.items():
+            walk = _TaintWalk(self, relpath, scope, out)
+            walk.block(fn.body)
+        self._check_vocab(relpath, tree, out)
+        self._check_coverage(relpath, tree, scopes, out)
+        return out
+
+    # -- closed bound vocabulary (the fault-catalog pattern) ------------
+
+    def _check_vocab(self, relpath: str, tree: ast.AST,
+                     out: list[Finding]) -> None:
+        owner = scope_map(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            last = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else "")
+            if last != "check_bound" or not node.args:
+                continue
+            scope = owner.get(node, "")
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                out.append(Finding(
+                    checker=self.name, path=relpath,
+                    line=node.lineno, rule="dynamic-bound-name",
+                    scope=scope,
+                    message="check_bound(<non-literal>) — bound "
+                            "names must be string literals from "
+                            "wire/schema.py's BOUNDS",
+                    detail="check_bound"))
+            elif arg.value not in _schema.BOUNDS:
+                out.append(Finding(
+                    checker=self.name, path=relpath,
+                    line=node.lineno, rule="unregistered-bound",
+                    scope=scope,
+                    message=f"bound {arg.value!r} is not declared "
+                            f"in wire/schema.py's BOUNDS — "
+                            f"check_bound would KeyError at parse "
+                            f"time",
+                    detail=arg.value))
+
+    # -- every declared bound is enforced where the schema says ---------
+
+    def _bound_used(self, node: ast.AST, key: str) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                f = n.func
+                last = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else "")
+                if last == "check_bound" and n.args \
+                        and isinstance(n.args[0], ast.Constant) \
+                        and n.args[0].value == key:
+                    return True
+            elif isinstance(n, ast.Subscript):
+                base = dotted_name(n.value)
+                if base.rsplit(".", 1)[-1] == "BOUNDS" \
+                        and isinstance(n.slice, ast.Constant) \
+                        and n.slice.value == key:
+                    return True
+        return False
+
+    def _check_coverage(self, relpath: str, tree: ast.AST,
+                        scopes: dict[str, ast.AST],
+                        out: list[Finding]) -> None:
+        sch = module_schema(relpath)
+        if sch is None or not scopes:
+            return
+        for bound in sch.bounds:
+            if bound.scope:
+                fn = scopes.get(bound.scope)
+                if fn is None:
+                    continue  # scope absent (partial fixture tree)
+                node, line = fn, fn.lineno
+            else:
+                node, line = tree, 1
+            if not self._bound_used(node, bound.name):
+                out.append(Finding(
+                    checker=self.name, path=relpath, line=line,
+                    rule="missing-plausibility-cap",
+                    scope=bound.scope,
+                    message=f"schema bound {bound.name!r} "
+                            f"({bound.doc or 'wire count'}, cap "
+                            f"{bound.cap}) is never enforced in "
+                            f"{bound.scope or relpath} — add "
+                            f"check_bound({bound.name!r}, ...)",
+                    detail=bound.name))

@@ -947,7 +947,7 @@ class Supervisor:
             # self-healing
             try:
                 ShmRing(name).unlink()
-            except (FileNotFoundError, ValueError):
+            except (FileNotFoundError, ValueError, FrameError):
                 pass
             self.rings.append(ShmRing(name, capacity=RING_BYTES,
                                       create=True))

@@ -45,18 +45,26 @@ from __future__ import annotations
 import struct
 from multiprocessing import shared_memory
 
-_MAGIC = 0x31475253  # "SRG1" little-endian
+from ..wire.schema import BOUNDS, SRG1, FrameError, check_bound
+
+# layout constants come from the declarative schema (wire/schema.py)
+_MAGIC = SRG1.magic
 _WRAP = 0xFFFFFFFF
-_HDR_SIZE = 64
-_OFF_MAGIC = 0
-_OFF_GEN = 4
-_OFF_HEAD = 8
-_OFF_TAIL = 16
-_OFF_DROPPED = 24
-_OFF_CAP = 32
+_HDR_SIZE = SRG1.header_size
+_OFF_MAGIC = SRG1.offsets["magic"]
+_OFF_GEN = SRG1.offsets["generation"]
+_OFF_HEAD = SRG1.offsets["head"]
+_OFF_TAIL = SRG1.offsets["tail"]
+_OFF_DROPPED = SRG1.offsets["dropped"]
+_OFF_CAP = SRG1.offsets["capacity"]
 
 #: Smallest record span: u32 length prefix. Also the wrap marker size.
 _LEN = 4
+
+#: plausibility cap on one length-prefixed record ("srg1.record_len"):
+#: the producer drops larger payloads, the consumer treats a larger
+#: prefix as corruption and resyncs at the producer cursor
+_REC_CAP = BOUNDS["srg1.record_len"]
 
 
 class ShmRing:
@@ -77,13 +85,43 @@ class ShmRing:
             struct.pack_into("<Q", buf, _OFF_CAP, capacity)
         else:
             self._shm = shared_memory.SharedMemory(name=name)
-        buf = self._shm.buf
+        self._attach(self._shm.buf)
+        self._owner = create
+
+    def _attach(self, buf) -> None:
+        """Validate the ring header and adopt ``buf``.  The segment
+        is wire data — a stale, truncated, or foreign segment must
+        fail typed, and a corrupt capacity must never size the
+        cursor math (cap=0 divides, cap > segment silently
+        short-slices)."""
+        name = self.name
+        if len(buf) < _HDR_SIZE:
+            raise FrameError(
+                f"shm segment {name!r} too small for ring header")
         (magic,) = struct.unpack_from("<I", buf, _OFF_MAGIC)
         if magic != _MAGIC:
-            raise ValueError(f"shm segment {name!r} is not a ring")
+            raise FrameError(f"shm segment {name!r} is not a ring")
         (self.capacity,) = struct.unpack_from("<Q", buf, _OFF_CAP)
+        check_bound("srg1.capacity", self.capacity)
+        if (self.capacity <= 2 * _LEN
+                or self.capacity != len(buf) - _HDR_SIZE):
+            raise FrameError(
+                f"shm segment {name!r}: implausible ring capacity "
+                f"{self.capacity} for {len(buf)}-byte segment")
         self._buf = buf
-        self._owner = create
+
+    @classmethod
+    def from_buffer(cls, buf, name: str = "<buffer>") -> "ShmRing":
+        """Attach to a raw ring image (tests / the schema-driven
+        fuzzer): same typed header validation as a shm attach, no
+        shared-memory segment behind it."""
+        self = cls.__new__(cls)
+        self.name = name
+        self._shm = None
+        self._owner = False
+        self._attach(memoryview(buf) if isinstance(buf, (bytes,
+                     bytearray)) else buf)
+        return self
 
     # -- header accessors ---------------------------------------------------
 
@@ -128,6 +166,12 @@ class ShmRing:
         it doesn't fit. Records larger than capacity - 2*_LEN - 1
         can never fit and always drop."""
         n = len(payload)
+        if n > _REC_CAP:
+            # over the schema's srg1.record_len cap: the consumer
+            # would treat the prefix as corruption, so drop loudly
+            # here instead of poisoning the ring
+            self._put(_OFF_DROPPED, self.dropped + 1)
+            return False
         head, tail = self.head, self.tail
         cap = self.capacity
         pos = head % cap
@@ -182,7 +226,7 @@ class ShmRing:
                 self._put(_OFF_TAIL, head)
                 return None
         (n,) = struct.unpack_from("<I", self._buf, _HDR_SIZE + pos)
-        if _LEN + n > cap - pos or n == _WRAP:
+        if _LEN + n > cap - pos or n == _WRAP or n > _REC_CAP:
             self._put(_OFF_TAIL, head)
             return None
         view = self._buf[_HDR_SIZE + pos + _LEN:
@@ -203,9 +247,12 @@ class ShmRing:
 
     def close(self) -> None:
         self._buf = None
-        self._shm.close()
+        if self._shm is not None:
+            self._shm.close()
 
     def unlink(self) -> None:
+        if self._shm is None:
+            return
         try:
             self._shm.unlink()
         except FileNotFoundError:

@@ -44,20 +44,23 @@ import struct
 import numpy as np
 
 from .distmsg import FrameError, _view_i32
+from .schema import DCB1, check_bound
 
 #: negotiated media type; requests carry it as Accept (capability
 #: advert) and, once confirmed, as Content-Type on binary bodies
 CONTENT_TYPE = "application/x-etcd-batch"
 
-_MAGIC = b"DCB1"
-_HDR = struct.Struct("<4sBBHI")
+# layout constants come from the declarative schema (wire/schema.py)
+_MAGIC = DCB1.magic
+_HDR = DCB1.header_struct()
 
-KIND_GET_REQ = 0
-KIND_GET_RESP = 1
-KIND_PROPOSE_RESP = 2
+_KINDS = DCB1.kind_values()
+KIND_GET_REQ = _KINDS["KIND_GET_REQ"]
+KIND_GET_RESP = _KINDS["KIND_GET_RESP"]
+KIND_PROPOSE_RESP = _KINDS["KIND_PROPOSE_RESP"]
 
 #: one sparse error row: op index i32, error code i32, msg len i32
-_ERR = struct.Struct("<iii")
+_ERR = struct.Struct(DCB1.structs["_ERR"])
 
 
 def _parse_header(data) -> tuple[int, int]:
@@ -67,6 +70,10 @@ def _parse_header(data) -> tuple[int, int]:
     magic, kind, _flags, _rsvd, count = _HDR.unpack_from(data)
     if magic != _MAGIC:
         raise FrameError("bad client frame magic")
+    # the header count sizes every downstream table view and the
+    # propose-ack return value — cap it before anything allocates
+    # (it used to flow through unpack_propose_response unchecked)
+    check_bound("dcb1.count", count)
     return kind, count
 
 
@@ -97,6 +104,7 @@ def unpack_get_request(data) -> list[str]:
         return []
     if int(plens.min()) < 0:
         raise FrameError("negative path length")
+    check_bound("dcb1.path_len", int(plens.max()))
     # int64 running ends: an adversarial table of huge i32 lens must
     # overflow into the bounds check, not wrap into a wrong slice
     ends = plens.cumsum(dtype=np.int64)
@@ -170,8 +178,7 @@ def _unpack_errs(data, pos: int,
         pos += _ERR.size
         if idx < 0 or idx >= count:
             raise FrameError("errs index out of range")
-        if mlen < 0:
-            raise FrameError("negative errs message length")
+        check_bound("dcb1.msg_len", mlen)
         rows.append((idx, code, mlen))
     return rows, pos
 
@@ -260,6 +267,10 @@ def unpack_get_response(
     vlens, pos = _view_i32(data, _HDR.size, count)
     if count and int(vlens.min()) < -1:
         raise FrameError("bad value length")
+    if count:
+        # -1 rows mean "absent" and are legal — cap the largest
+        # actual value length only
+        check_bound("dcb1.val_len", max(0, int(vlens.max())))
     rows, pos = _unpack_errs(data, pos, count)
     total = int(np.maximum(vlens, 0).sum(dtype=np.int64))
     if pos + total > len(data):

@@ -22,6 +22,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from .schema import check_bound
+
 _MASK64 = (1 << 64) - 1
 
 
@@ -104,6 +106,7 @@ def _expect_wt(fnum: int, wt: int, want: int) -> None:
 
 def _bytes_field(data: bytes, pos: int) -> tuple[bytes, int]:
     n, pos = uvarint(data, pos)
+    check_bound("gpb1.len", n, err=ProtoError)
     if pos + n > len(data):
         raise ProtoError("truncated bytes field")
     return bytes(data[pos : pos + n]), pos + n
@@ -271,8 +274,7 @@ class Message:
             _tagged_bytes(buf, 0x3A, e.marshal())
         _tagged_varint(buf, 0x40, self.commit)
         _tagged_bytes(buf, 0x4A, self.snapshot.marshal())
-        buf.append(0x50)
-        buf.append(1 if self.reject else 0)
+        _tagged_varint(buf, 0x50, 1 if self.reject else 0)
         return bytes(buf)
 
     @classmethod

@@ -36,6 +36,17 @@ def _tagged_string(buf: bytearray, tag: int, s: str) -> None:
     buf.extend(b)
 
 
+def _string_field(data: bytes, pos: int) -> tuple[str, int]:
+    """Length-delimited utf-8 field; a non-utf-8 blob fails typed as
+    ProtoError, never an escaping UnicodeDecodeError (these payloads
+    arrive over the FWD_REQ handoff and out of the WAL)."""
+    b, pos = _bytes_field(data, pos)
+    try:
+        return b.decode(), pos
+    except UnicodeDecodeError:
+        raise ProtoError("string field not utf-8") from None
+
+
 @dataclass(slots=True)
 class Request:
     id: int = 0
@@ -96,24 +107,20 @@ class Request:
                 r.id, pos = uvarint(data, pos)
             elif fnum == 2:
                 _expect_wt(fnum, wt, 2)
-                b, pos = _bytes_field(data, pos)
-                r.method = b.decode()
+                r.method, pos = _string_field(data, pos)
             elif fnum == 3:
                 _expect_wt(fnum, wt, 2)
-                b, pos = _bytes_field(data, pos)
-                r.path = b.decode()
+                r.path, pos = _string_field(data, pos)
             elif fnum == 4:
                 _expect_wt(fnum, wt, 2)
-                b, pos = _bytes_field(data, pos)
-                r.val = b.decode()
+                r.val, pos = _string_field(data, pos)
             elif fnum == 5:
                 _expect_wt(fnum, wt, 0)
                 v, pos = uvarint(data, pos)
                 r.dir = bool(v)
             elif fnum == 6:
                 _expect_wt(fnum, wt, 2)
-                b, pos = _bytes_field(data, pos)
-                r.prev_value = b.decode()
+                r.prev_value, pos = _string_field(data, pos)
             elif fnum == 7:
                 _expect_wt(fnum, wt, 0)
                 r.prev_index, pos = uvarint(data, pos)

@@ -47,16 +47,19 @@ import struct
 import numpy as np
 
 from .distmsg import FrameError, _view_i32
+from .schema import DRH1, check_bound
 from ..store.event import Event, NodeExtern
 
-_MAGIC = b"DRH1"
-_HDR = struct.Struct("<4sBBHI")
+# layout constants come from the declarative schema (wire/schema.py)
+_MAGIC = DRH1.magic
+_HDR = DRH1.header_struct()
 
-KIND_FWD_REQ = 0
-KIND_FWD_ACKS = 1
-KIND_FWD_VALS = 2
-KIND_FWD_RESP = 3
-KIND_COMMIT = 4
+_KINDS = DRH1.kind_values()
+KIND_FWD_REQ = _KINDS["KIND_FWD_REQ"]
+KIND_FWD_ACKS = _KINDS["KIND_FWD_ACKS"]
+KIND_FWD_VALS = _KINDS["KIND_FWD_VALS"]
+KIND_FWD_RESP = _KINDS["KIND_FWD_RESP"]
+KIND_COMMIT = _KINDS["KIND_COMMIT"]
 
 # FWD_REQ header flags: requested reply shape
 REPLY_EVENTS = 0       # FWD_RESP (full v2 events)
@@ -67,13 +70,13 @@ REPLY_VALS = 0x02      # FWD_VALS (read batch: leaf values)
 OP_SERIALIZABLE = 0x01
 
 #: one sparse error row: op index i32, error code i32, msg len i32
-_ERR = struct.Struct("<iii")
+_ERR = struct.Struct(DRH1.structs["_ERR"])
 
 #: one FWD_RESP event row (72 bytes):
 #: code i32 | action u8 | flags u8 | rsvd u16 | etcd_index i64 |
 #: mod i64 | created i64 | pmod i64 | pcreated i64 | expiration f64 |
 #: ttl i32 | klen i32 | vlen i32 | pvlen i32
-_EVT = struct.Struct("<iBBHqqqqqdiiii")
+_EVT = struct.Struct(DRH1.structs["_EVT"])
 
 F_ERR = 0x01        # error row: code + cause (klen bytes), index
 F_HAS_NODE = 0x02
@@ -95,6 +98,10 @@ def _parse_header(data) -> tuple[int, int, int]:
     magic, kind, flags, _rsvd, count = _HDR.unpack_from(data)
     if magic != _MAGIC:
         raise FrameError("bad role frame magic")
+    # the header count sizes every downstream table view and the
+    # fwd_acks return value — cap it before anything allocates (it
+    # used to flow through unpack_fwd_acks unchecked)
+    check_bound("drh1.count", count)
     return kind, flags, count
 
 
@@ -120,6 +127,8 @@ def _lens_blobs(blobs: list[bytes]) -> tuple[bytes, bytes]:
 def _slice_blobs(data, pos: int, lens: np.ndarray) -> list[bytes]:
     if lens.size and int(lens.min()) < 0:
         raise FrameError("negative blob length")
+    if lens.size:
+        check_bound("drh1.blob_len", int(lens.max()))
     # int64 running ends: adversarial i32 lens must overflow into the
     # bounds check, never wrap into a wrong slice
     ends = lens.cumsum(dtype=np.int64)
@@ -129,8 +138,7 @@ def _slice_blobs(data, pos: int, lens: np.ndarray) -> list[bytes]:
     out = []
     a = pos
     for b in ends.tolist():
-        out.append(bytes(data[pos:pos + 0]) if False else
-                   bytes(data[a:pos + b]))
+        out.append(bytes(data[a:pos + b]))
         a = pos + b
     return out
 
@@ -199,8 +207,7 @@ def _unpack_errs(data, pos: int, count: int
         pos += _ERR.size
         if idx < 0 or idx >= count:
             raise FrameError("errs index out of range")
-        if mlen < 0:
-            raise FrameError("negative errs message length")
+        check_bound("drh1.msg_len", mlen)
         rows.append((idx, code, mlen))
     return rows, pos
 
@@ -265,6 +272,10 @@ def unpack_fwd_vals(data) -> tuple[list[bytes | None],
     vlens, pos = _view_i32(data, _HDR.size, count)
     if count and int(vlens.min()) < -1:
         raise FrameError("bad value length")
+    if count:
+        # -1 rows mean "absent" and are legal — cap the largest
+        # actual value length only
+        check_bound("drh1.val_len", max(0, int(vlens.max())))
     rows, pos = _unpack_errs(data, pos, count)
     total = int(np.maximum(vlens, 0).sum(dtype=np.int64))
     if pos + total > len(data):

@@ -1762,3 +1762,402 @@ def test_lint_per_checker_timings_on_metrics(tmp_path):
 
 if __name__ == "__main__":
     raise SystemExit(pytest.main([__file__, "-q"]))
+
+
+# -- 18. wire-bounds fires on unchecked wire-derived counts (PR 19) -----------
+
+
+def test_wirebounds_fires_on_unchecked_count_sinks(tmp_path):
+    from etcd_tpu.analysis import WireBoundsChecker
+
+    root = _fixture_root(tmp_path, "etcd_tpu/wire/peermsg.py", """
+        import struct
+        import numpy as np
+
+        def unpack_table(data):
+            (n,) = struct.unpack_from("<I", data, 0)
+            out = bytearray(n)
+            for i in range(n):
+                pass
+            arr = np.frombuffer(data, "<i4", count=n, offset=4)
+            pad = b"\\x00" * n
+            return out, arr, pad
+        """)
+    findings = run_checkers(root, [WireBoundsChecker()])
+    assert _rules(findings) == {"unchecked-wire-count"}
+    sinks = {f.detail.split(":")[0] for f in findings}
+    assert sinks == {"allocation", "range", "frombuffer-count",
+                     "sequence-repeat"}
+
+
+def test_wirebounds_quiet_on_guarded_counts(tmp_path):
+    from etcd_tpu.analysis import WireBoundsChecker
+
+    root = _fixture_root(tmp_path, "etcd_tpu/wire/peermsg.py", """
+        import struct
+        from .schema import FrameError, check_bound
+
+        def unpack_table(data):
+            (n,) = struct.unpack_from("<I", data, 0)
+            if 4 + 4 * n > len(data):
+                raise FrameError("truncated table")
+            out = bytearray(n)
+            for i in range(n):
+                pass
+            return out
+
+        def unpack_capped(data):
+            (n,) = struct.unpack_from("<I", data, 0)
+            check_bound("dgb2.groups", n)
+            return bytearray(n)
+        """)
+    assert not run_checkers(root, [WireBoundsChecker()])
+
+
+def test_wirebounds_closes_the_bound_vocabulary(tmp_path):
+    from etcd_tpu.analysis import WireBoundsChecker
+
+    root = _fixture_root(tmp_path, "etcd_tpu/wire/peermsg.py", """
+        from .schema import check_bound
+
+        def unpack_thing(data, which):
+            n = len(data)
+            check_bound(which, n)
+            check_bound("peer.bogus_count", n)
+        """)
+    findings = run_checkers(root, [WireBoundsChecker()])
+    assert _rules(findings) == {"dynamic-bound-name",
+                                "unregistered-bound"}
+
+
+def test_wirebounds_fires_on_missing_plausibility_cap(tmp_path):
+    from etcd_tpu.analysis import WireBoundsChecker
+
+    # a partial shmring at the real relpath is held to the REAL SRG1
+    # schema: srg1.capacity must be capped in ShmRing._attach and
+    # srg1.record_len somewhere in the module
+    root = _fixture_root(tmp_path, "etcd_tpu/server/shmring.py", """
+        import struct
+        from ..wire.schema import FrameError
+
+        class ShmRing:
+            def _attach(self, buf):
+                if len(buf) < 64:
+                    raise FrameError("short segment")
+                (cap,) = struct.unpack_from("<Q", buf, 32)
+                self.capacity = cap
+        """)
+    findings = run_checkers(root, [WireBoundsChecker()])
+    assert _rules(findings) == {"missing-plausibility-cap"}
+    assert {f.detail for f in findings} == {"srg1.capacity",
+                                            "srg1.record_len"}
+
+
+def test_wirebounds_quiet_when_caps_enforced(tmp_path):
+    from etcd_tpu.analysis import WireBoundsChecker
+
+    root = _fixture_root(tmp_path, "etcd_tpu/server/shmring.py", """
+        import struct
+        from ..wire.schema import BOUNDS, FrameError, check_bound
+
+        _REC_CAP = BOUNDS["srg1.record_len"]
+
+        class ShmRing:
+            def _attach(self, buf):
+                if len(buf) < 64:
+                    raise FrameError("short segment")
+                (cap,) = struct.unpack_from("<Q", buf, 32)
+                check_bound("srg1.capacity", cap)
+                self.capacity = cap
+        """)
+    assert not run_checkers(root, [WireBoundsChecker()])
+
+
+# -- 19. frame-totality fires on untyped parse escapes (PR 19) ----------------
+
+
+def test_frametotality_fires_on_untyped_decode_and_unpack(tmp_path):
+    from etcd_tpu.analysis import FrameTotalityChecker
+
+    root = _fixture_root(tmp_path, "etcd_tpu/wire/peermsg.py", """
+        import json
+        import struct
+
+        def parse_head(data):
+            (n,) = struct.unpack_from("<I", data, 0)
+            return n
+
+        def unpack_name(data):
+            return data[4:].decode()
+
+        def unpack_meta(data):
+            return json.loads(data)
+        """)
+    findings = run_checkers(root, [FrameTotalityChecker()])
+    assert _rules(findings) == {"unguarded-unpack", "untyped-decode"}
+    assert {f.detail for f in findings} == {"struct.unpack_from",
+                                            "decode", "json.loads"}
+
+
+def test_frametotality_quiet_on_typed_parse(tmp_path):
+    from etcd_tpu.analysis import FrameTotalityChecker
+
+    root = _fixture_root(tmp_path, "etcd_tpu/wire/peermsg.py", """
+        import json
+        import struct
+        from .schema import FrameError
+
+        def parse_head(data):
+            if len(data) < 4:
+                raise FrameError("short frame")
+            (n,) = struct.unpack_from("<I", data, 0)
+            return n
+
+        def unpack_name(data):
+            try:
+                return data[4:].decode()
+            except UnicodeDecodeError:
+                raise FrameError("name not utf-8") from None
+
+        def unpack_meta(data):
+            try:
+                return json.loads(data)
+            except (ValueError, KeyError, TypeError):
+                raise FrameError("bad meta json") from None
+        """)
+    assert not run_checkers(root, [FrameTotalityChecker()])
+
+
+def test_frametotality_fires_on_dropped_kind_checks(tmp_path):
+    from etcd_tpu.analysis import FrameTotalityChecker
+
+    # a partial clientmsg at the real relpath is held to the REAL
+    # DCB1 schema: the unmarshal scope exists but never rejects its
+    # kind, and nothing rejects an unknown kind typed
+    root = _fixture_root(tmp_path, "etcd_tpu/wire/clientmsg.py", """
+        import struct
+        from .schema import FrameError
+
+        KIND_GET_REQ = 0
+
+        def _parse_header(data):
+            if len(data) < 12:
+                raise FrameError("short client frame")
+            hdr = struct.unpack_from("<4sBBHI", data)
+            return hdr[1], hdr[4]
+
+        def unpack_get_request(data):
+            kind, count = _parse_header(data)
+            return count
+        """)
+    findings = run_checkers(root, [FrameTotalityChecker()])
+    assert _rules(findings) == {"unhandled-kind",
+                                "missing-unknown-kind-rejection"}
+
+
+def test_frametotality_fires_on_unhandled_flag(tmp_path):
+    from etcd_tpu.analysis import FrameTotalityChecker
+
+    # DGB2 declares FLAG_TRACE and FLAG_PACKED with parse scope
+    # AppendBatch.unmarshal; testing only one of them is a finding
+    # for the other (its trailing section would be misparsed)
+    root = _fixture_root(tmp_path, "etcd_tpu/wire/distmsg.py", """
+        from .schema import FrameError
+
+        KIND_APPEND = 0
+        FLAG_TRACE = 0x0001
+        FLAG_PACKED = 0x0002
+
+        class AppendBatch:
+            @classmethod
+            def unmarshal(cls, data):
+                kind = data[4]
+                if kind != KIND_APPEND:
+                    raise FrameError("kind")
+                flags = data[6]
+                trace = None
+                if flags & FLAG_TRACE:
+                    trace = []
+                return cls()
+        """)
+    findings = run_checkers(root, [FrameTotalityChecker()])
+    assert _rules(findings) == {"unhandled-flag"}
+    assert {f.detail for f in findings} == {"FLAG_PACKED"}
+
+
+# -- 20. schema-drift fires on layout divergence (PR 19) ----------------------
+
+
+def test_schemadrift_fires_on_local_layout_literals(tmp_path):
+    from etcd_tpu.analysis import SchemaDriftChecker
+
+    root = _fixture_root(tmp_path, "etcd_tpu/wire/peermsg.py", """
+        import struct
+
+        _HDR = struct.Struct("<4sBBHIIII")
+        _MAGIC = b"DGB2"
+        """)
+    findings = run_checkers(root, [SchemaDriftChecker()])
+    assert _rules(findings) == {"local-struct-literal",
+                                "local-magic-literal"}
+
+
+def test_schemadrift_quiet_on_schema_imports(tmp_path):
+    from etcd_tpu.analysis import SchemaDriftChecker
+
+    root = _fixture_root(tmp_path, "etcd_tpu/wire/peermsg.py", """
+        from .schema import DGB2
+
+        _MAGIC = DGB2.magic
+        _HDR = DGB2.header_struct()
+        """)
+    assert not run_checkers(root, [SchemaDriftChecker()])
+
+
+def test_schemadrift_fires_on_reordered_sections(tmp_path):
+    from etcd_tpu.analysis import SchemaDriftChecker
+
+    # the REAL DGB2 schema declares AppendResp sections as
+    # term/acked/hint/ok/active — a marshal writing acked first is
+    # the silent-corruption drift this rule exists for
+    root = _fixture_root(tmp_path, "etcd_tpu/wire/distmsg.py", """
+        class AppendResp:
+            def marshal(self):
+                out = bytearray(64)
+                pos = 0
+                pos = _w_i32(out, pos, self.acked)
+                pos = _w_i32(out, pos, self.term)
+                pos = _w_i32(out, pos, self.hint)
+                pos = _w_u8(out, pos, self.ok)
+                pos = _w_u8(out, pos, self.active)
+                return out
+        """)
+    findings = run_checkers(root, [SchemaDriftChecker()])
+    assert _rules(findings) == {"section-drift"}
+    assert {f.detail for f in findings} == {"KIND_APPEND_RESP:marshal"}
+
+
+def test_schemadrift_quiet_on_declared_section_order(tmp_path):
+    from etcd_tpu.analysis import SchemaDriftChecker
+
+    root = _fixture_root(tmp_path, "etcd_tpu/wire/distmsg.py", """
+        class AppendResp:
+            def marshal(self):
+                out = bytearray(64)
+                pos = 0
+                pos = _w_i32(out, pos, self.term)
+                pos = _w_i32(out, pos, self.acked)
+                pos = _w_i32(out, pos, self.hint)
+                pos = _w_u8(out, pos, self.ok)
+                pos = _w_u8(out, pos, self.active)
+                return out
+        """)
+    assert not run_checkers(root, [SchemaDriftChecker()])
+
+
+def test_schemadrift_fires_on_proto_field_divergence(tmp_path):
+    from etcd_tpu.analysis import SchemaDriftChecker
+
+    # GPB1 declares HardState field 3 (commit) as wire type 0; tag
+    # 0x19 = (3 << 3) | 1 writes it as fixed64 — field-drift
+    root = _fixture_root(tmp_path, "etcd_tpu/wire/proto.py", """
+        class HardState:
+            def marshal(self):
+                buf = bytearray()
+                _tagged_varint(buf, 0x08, self.term)
+                _tagged_varint(buf, 0x10, self.vote)
+                _tagged_varint(buf, 0x19, self.commit)
+                return bytes(buf)
+        """)
+    findings = run_checkers(root, [SchemaDriftChecker()])
+    assert _rules(findings) == {"field-drift"}
+    assert {f.detail for f in findings} == {"HardState.f3:marshal"}
+
+
+# -- 21. the schemas pin the real modules (PR 19) -----------------------------
+
+
+def test_wire_schema_matches_real_modules():
+    """Drift guard in the OTHER direction: the declarative schemas
+    (wire/schema.py) must describe the code that actually ships —
+    struct formats, magics, kind values, flag bits, SRG1 offsets,
+    and section/field names that exist on the real dataclasses."""
+    import dataclasses
+    import struct as pystruct
+
+    from etcd_tpu.server import shmring
+    from etcd_tpu.wire import clientmsg, distmsg, proto, rolemsg
+    from etcd_tpu.wire import schema
+
+    # header formats and magics are what the modules actually use
+    assert distmsg._HDR.format == schema.DGB2.header
+    assert clientmsg._HDR.format == schema.DCB1.header
+    assert rolemsg._HDR.format == schema.DRH1.header
+    assert distmsg._MAGIC == schema.DGB2.magic
+    assert clientmsg._MAGIC == schema.DCB1.magic
+    assert rolemsg._MAGIC == schema.DRH1.magic
+    assert shmring._MAGIC == schema.SRG1.magic
+
+    # kind values and flag bits equal the module constants
+    for mod, sch in ((distmsg, schema.DGB2), (clientmsg, schema.DCB1),
+                     (rolemsg, schema.DRH1)):
+        for kind in sch.kinds:
+            assert getattr(mod, kind.name) == kind.value, kind.name
+        for flag in sch.flags:
+            assert getattr(mod, flag.name) == flag.bit, flag.name
+
+    # the struct catalog round-trips through the modules
+    assert distmsg._TRACE_ENT.format == schema.DGB2.structs["_TRACE_ENT"]
+    assert clientmsg._ERR.format == schema.DCB1.structs["_ERR"]
+    assert rolemsg._ERR.format == schema.DRH1.structs["_ERR"]
+    assert rolemsg._EVT.format == schema.DRH1.structs["_EVT"]
+
+    # SRG1 fixed offsets are the shmring's real field offsets
+    assert shmring._HDR_SIZE == schema.SRG1.header_size
+    for field, off in (("magic", shmring._OFF_MAGIC),
+                       ("generation", shmring._OFF_GEN),
+                       ("head", shmring._OFF_HEAD),
+                       ("tail", shmring._OFF_TAIL),
+                       ("dropped", shmring._OFF_DROPPED),
+                       ("capacity", shmring._OFF_CAP)):
+        assert schema.SRG1.offsets[field] == off, field
+
+    # header_offsets() tiles the whole packed header exactly
+    for sch in (schema.DGB2, schema.DCB1, schema.DRH1):
+        offs = sch.header_offsets()
+        assert set(offs) == set(sch.header_fields)
+        assert sum(w for _o, w, _s in offs.values()) \
+            == pystruct.calcsize(sch.header)
+        for cf in sch.count_fields:
+            assert cf in offs, cf
+
+    # DGB2 section names name real dataclass fields ("lens" is the
+    # derived payload length table, the one non-attribute section)
+    for kind in schema.DGB2.kinds:
+        if not kind.cls:
+            continue
+        cls = getattr(distmsg, kind.cls)
+        fields = {f.name for f in dataclasses.fields(cls)}
+        for s in kind.sections:
+            assert s.name in fields | {"lens"}, \
+                f"{kind.cls}.{s.name}"
+
+    # GPB1 field names are real attributes of the real messages
+    for msg in schema.GPB1.messages:
+        cls = getattr(proto, msg.cls, None) or {
+            "Entry": proto.Entry}[msg.cls]
+        names = {f.name for f in dataclasses.fields(cls)} \
+            if dataclasses.is_dataclass(cls) else set(cls.__slots__)
+        for f in msg.fields:
+            assert f.name in names, f"{msg.cls}.{f.name}"
+
+    # every declared bound cap is positive and every flag scope /
+    # bound scope that is non-empty appears in parse_scopes
+    for sch in schema.FORMATS:
+        for b in sch.bounds:
+            assert b.cap > 0
+            if b.scope:
+                assert b.scope in sch.parse_scopes, b.name
+        for fl in sch.flags:
+            if fl.scope:
+                assert fl.scope in sch.parse_scopes, fl.name

@@ -312,3 +312,58 @@ def test_decoder_total_on_mutations(seed):
                 type(msg).unmarshal(bytes(wire))
             except ProtoError:
                 pass  # the one allowed failure mode
+
+
+# -- schema-driven sweeps (PR 19): scripts/wire_fuzz.py as a library --------
+#
+# The standalone fuzzer owns the big randomized budgets (scripts/test
+# runs --smoke; --check is the 100k/format acceptance gate); tier-1
+# pins the DETERMINISTIC schema-driven sweeps — truncation at every
+# byte offset, every flag bit, every count-field extreme — for all
+# five formats, so a new section or bound is covered the day it is
+# declared in wire/schema.py.
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts"))
+
+import wire_fuzz  # noqa: E402
+
+
+@pytest.mark.parametrize("fmt", sorted(wire_fuzz.FORMATS))
+def test_schema_truncation_at_every_offset(fmt):
+    """Every prefix of every valid seed frame parses or fails as the
+    format's typed error — no truncation point escapes as
+    struct.error/IndexError (wire_fuzz._run_one re-raises any escape
+    as a Crasher, which pytest reports)."""
+    sch, make_seeds = wire_fuzz.FORMATS[fmt]
+    for parser, seed in make_seeds():
+        for end in range(len(seed) + 1):
+            wire_fuzz._run_one(fmt, sch, parser, seed[:end])
+
+
+@pytest.mark.parametrize("fmt", sorted(wire_fuzz.FORMATS))
+def test_schema_flag_and_count_extremes(fmt):
+    """Flag-bit flips (declared + undeclared) and count-field
+    extremes written through FrameSchema.header_offsets() stay inside
+    the typed-error contract."""
+    sch, make_seeds = wire_fuzz.FORMATS[fmt]
+    for parser, seed in make_seeds():
+        for m in wire_fuzz._flag_mutations(sch, seed):
+            wire_fuzz._run_one(fmt, sch, parser, m)
+        for m in wire_fuzz._field_mutations(sch, seed):
+            wire_fuzz._run_one(fmt, sch, parser, m)
+        if fmt == "srg1":
+            for m in wire_fuzz._srg1_header_mutations(sch, seed):
+                wire_fuzz._run_one(fmt, sch, parser, m)
+
+
+def test_persisted_crashers_stay_fixed():
+    """Any crasher scripts/wire_fuzz.py ever persisted under
+    tests/fixtures/wire_crashers/ is replayed here — a reintroduced
+    parser bug fails tier-1, not just the next fuzz run."""
+    for fmt, (sch, make_seeds) in wire_fuzz.FORMATS.items():
+        wire_fuzz._replay_fixtures(fmt, sch, make_seeds())
